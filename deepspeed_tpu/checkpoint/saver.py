@@ -1,0 +1,74 @@
+"""Sharded checkpoint save/load.
+
+Replaces the reference's per-rank torch.save files
+(``mp_rank_XX_model_states.pt`` + ``*_optim_states.pt``, engine.py:2467/:2457)
+with a layout keyed by pytree path: one ``.npy`` per leaf plus a JSON manifest.
+Arrays sharded over the mesh are fetched shard-wise via
+``jax.experimental.multihost_utils`` semantics (single-process: device_get).
+
+The 'latest' tag-file protocol (engine.py:3056) is kept by the engine caller.
+Resharding on load is free: leaves are restored with ``jax.device_put`` against
+the *current* shardings, so loading a ZeRO-3 checkpoint into a different mesh
+shape just works — this subsumes the reference's elastic re-partitioning
+(stage_1_and_2.py:2068) and offline reshape tools for same-topology cases.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+PyTree = Any
+_SEP = "::"
+
+
+def _flatten_with_paths(tree) -> dict[str, Any]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = _SEP.join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        flat[key] = leaf
+    return flat
+
+
+def save_checkpoint(ckpt_dir: str, state: PyTree, client_state: Optional[dict] = None) -> None:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    flat = _flatten_with_paths(state)
+    manifest = {"leaves": {}, "client_state": client_state or {}}
+    for key, leaf in flat.items():
+        arr = np.asarray(jax.device_get(leaf))
+        fname = key.replace("/", "_") + ".npy"
+        np.save(os.path.join(ckpt_dir, fname), arr)
+        manifest["leaves"][key] = {"file": fname, "shape": list(arr.shape), "dtype": str(arr.dtype)}
+    with open(os.path.join(ckpt_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def load_checkpoint(ckpt_dir: str, state_like: PyTree, shardings: Optional[PyTree] = None):
+    """Restore into the structure of ``state_like``; missing leaves keep their
+    current value (reference: load_module_strict=False path, engine.py:2587)."""
+    with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+
+    flat_like = _flatten_with_paths(state_like)
+    flat_shard = _flatten_with_paths(shardings) if shardings is not None else {}
+    restored = {}
+    for key, leaf in flat_like.items():
+        entry = manifest["leaves"].get(key)
+        if entry is None:
+            restored[key] = leaf
+            continue
+        arr = np.load(os.path.join(ckpt_dir, entry["file"]))
+        sharding = flat_shard.get(key)
+        restored[key] = jax.device_put(arr, sharding) if sharding is not None else jax.device_put(arr)
+
+    leaves_paths, treedef = jax.tree_util.tree_flatten_with_path(state_like)
+    ordered = []
+    for path, _ in leaves_paths:
+        key = _SEP.join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        ordered.append(restored[key])
+    return jax.tree_util.tree_unflatten(treedef, ordered), manifest.get("client_state", {})

@@ -1,0 +1,38 @@
+"""``deepspeed_tpu.comm`` — stable communication façade (SURVEY.md §2.3).
+
+Everything above this layer imports ``from deepspeed_tpu import comm as dist``
+the way reference code does ``from deepspeed import comm as dist``
+(reference: comm/comm.py:14-22 compatibility contract). Process groups are
+replaced by one named ``jax.sharding.Mesh`` (see ``mesh.py``) and eager NCCL
+ops by XLA collectives traced over axis names (see ``collectives.py``).
+"""
+
+from .collectives import (
+    all_gather,
+    all_reduce,
+    all_to_all,
+    axis_index,
+    axis_size_in_jit,
+    barrier,
+    broadcast_in_axis,
+    get_local_rank,
+    get_rank,
+    get_world_size,
+    init_distributed,
+    is_initialized,
+    ppermute,
+    reduce_scatter,
+    ring_shift,
+)
+from .logger import CommsLogger, comms_logger, get_bw
+from .mesh import (
+    AXIS_ORDER,
+    MeshConfig,
+    axis_size,
+    batch_sharding,
+    build_mesh,
+    data_parallel_size,
+    named_sharding,
+    replicated,
+    single_device_mesh,
+)

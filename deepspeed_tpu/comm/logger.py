@@ -1,0 +1,70 @@
+"""Trace-time collective logging — analogue of ``CommsLogger``
+(reference: utils/comms_logging.py:56, hooked via comm/comm.py:111 timed_op).
+
+Because XLA compiles collectives, we can't time each op eagerly; instead we
+record (op, axis, message size) when tracing, and bandwidth/latency comes from
+`jax.profiler` traces. The summary still reports per-op counts and volumes the
+way ``comm.log_summary()`` does (comm/comm.py:461).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+def _nbytes(tensor) -> int:
+    try:
+        size = int(np.prod(tensor.shape))
+        return size * tensor.dtype.itemsize
+    except Exception:
+        return 0
+
+
+class CommsLogger:
+    def __init__(self):
+        self.enabled = False
+        self.verbose = False
+        self.prof_ops: dict[str, dict] = defaultdict(lambda: {"count": 0, "bytes": 0})
+
+    def configure(self, enabled: bool = False, verbose: bool = False, **_):
+        self.enabled = enabled
+        self.verbose = verbose
+
+    def record(self, op: str, axis, tensor) -> None:
+        if not self.enabled:
+            return
+        key = f"{op}@{axis}"
+        entry = self.prof_ops[key]
+        entry["count"] += 1
+        entry["bytes"] += _nbytes(tensor)
+        if self.verbose:
+            logger.info(f"comm trace: {key} msg={_nbytes(tensor)}B")
+
+    def log_all(self) -> None:
+        logger.info("collective trace summary (per-compile counts):")
+        for key, entry in sorted(self.prof_ops.items()):
+            logger.info(f"  {key}: count={entry['count']} volume={entry['bytes'] / 1e6:.2f} MB")
+
+    def reset(self) -> None:
+        self.prof_ops.clear()
+
+
+comms_logger = CommsLogger()
+
+
+def get_bw(comm_op: str, size_bytes: int, duration_s: float, n_ranks: int) -> tuple[float, float]:
+    """Algorithmic and bus bandwidth in GB/s (reference: utils/comms_logging.py:23)."""
+    if duration_s <= 0:
+        return 0.0, 0.0
+    algbw = size_bytes / duration_s / 1e9
+    if comm_op in ("all_reduce",):
+        busbw = algbw * (2 * (n_ranks - 1) / n_ranks)
+    elif comm_op in ("all_gather", "reduce_scatter", "all_to_all"):
+        busbw = algbw * ((n_ranks - 1) / n_ranks)
+    else:
+        busbw = algbw
+    return algbw, busbw

@@ -1,0 +1,121 @@
+"""Device-mesh construction — the TPU-native replacement for process groups.
+
+The reference builds torch.distributed process groups for every parallel axis
+(``deepspeed/utils/groups.py:45-397``: world, DP, MP clones, EP dictionaries).
+On TPU the idiomatic equivalent is ONE ``jax.sharding.Mesh`` with named axes;
+"creating a group" becomes selecting an axis name, and the rank algebra the
+reference spells out by hand (groups.py:163 comment block) falls out of the
+mesh's cartesian structure.
+
+Axis naming convention (outer → inner, i.e. DCN-ish → ICI-ish):
+
+    pipe   (pp)  pipeline stages
+    data   (dp)  pure data parallel (replicated params)
+    fsdp         ZeRO-3 parameter/grad/optimizer sharding axis
+    context (sp) sequence/context parallelism (ring attention)
+    model  (tp)  tensor parallelism
+    expert (ep)  expert parallelism — carved out of data×fsdp at use sites
+
+Outer axes change slowest across the physical device order, so placing ``data``
+outermost keeps model axes on ICI neighbours and DP traffic on DCN for
+multi-slice topologies (cf. SURVEY.md §5 "DCN vs ICI hierarchy").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..utils.logging import logger
+
+# Canonical axis order, outermost first.
+AXIS_ORDER = ("pipe", "data", "fsdp", "context", "model")
+
+# Expert parallelism reuses the data/fsdp devices (reference: utils/groups.py:109
+# "expert parallel group is a subset of data parallel group").
+EXPERT_AXES = ("data", "fsdp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Requested size per logical axis; -1 on at most one axis = use remainder."""
+
+    pipe: int = 1
+    data: int = -1
+    fsdp: int = 1
+    context: int = 1
+    model: int = 1
+
+    def sizes(self, n_devices: int) -> dict[str, int]:
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        unknown = [a for a, s in sizes.items() if s == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {unknown}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if unknown:
+            if n_devices % fixed != 0:
+                raise ValueError(f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            sizes[unknown[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(f"mesh {sizes} does not cover {n_devices} devices")
+        return sizes
+
+
+def build_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Sequence[str] = AXIS_ORDER,
+) -> Mesh:
+    """Build the global device mesh.
+
+    Replaces ``_create_model_parallel`` / ``_create_expert_and_data_parallel``
+    (reference: utils/groups.py:89/:109): every parallel "group" is a slice of
+    this one mesh.
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.sizes(len(devices))
+    shape = tuple(sizes[a] for a in axis_names)
+    dev_array = np.asarray(devices).reshape(shape)
+    mesh = Mesh(dev_array, axis_names=tuple(axis_names))
+    logger.info(f"built mesh {dict(zip(axis_names, shape))} over {len(devices)} devices")
+    return mesh
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]).reshape((1,) * len(AXIS_ORDER)), AXIS_ORDER)
+
+
+def axis_size(mesh: Mesh, *axes: str) -> int:
+    return math.prod(mesh.shape.get(a, 1) for a in axes)
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    """World size of the gradient-averaging group = data × fsdp × context.
+
+    (context-parallel ranks see different sequence chunks of the same batch
+    rows, but grads are averaged over the full data×fsdp×context product.)
+    """
+    return axis_size(mesh, "data", "fsdp")
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Canonical input-batch sharding: batch over (data, fsdp), seq over context."""
+    return NamedSharding(mesh, PartitionSpec(("data", "fsdp"), "context"))
+
+
+def local_batch_slice(mesh: Mesh, global_batch: int) -> int:
+    return global_batch // data_parallel_size(mesh)

@@ -1,0 +1,392 @@
+"""Decoder-only transformer — the framework's flagship model family.
+
+Covers GPT-2 (learned pos-emb), GPT-NeoX (rotary, parallel residual) and
+BLOOM-style (alibi) decoders with one configurable implementation — the same
+architectures the reference's inference policies target
+(module_inject/replace_policy.py:129/:219/:381/:435).
+
+TPU-first design choices:
+  * functional: ``init(rng) -> params`` pytree + ``apply(params, tokens)``;
+    no module objects, so the engine can shard/donate freely.
+  * layer stack is a SINGLE stacked pytree scanned with ``lax.scan`` — one
+    compiled layer body regardless of depth (XLA-friendly; contrast with the
+    reference's per-layer C++ objects, csrc/transformer/ds_transformer_cuda.cpp).
+  * every parameter carries logical axis names so parallel/sharding.py can map
+    ZeRO/TP/EP placements onto it.
+  * attention implementation is pluggable ("xla" einsum, "flash" Pallas,
+    "ring" context-parallel) — see ops/ and parallel/ring_attention.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Any
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None  # default 4*hidden
+    pos_emb: str = "learned"  # learned | rotary | alibi | none
+    rotary_pct: float = 1.0
+    parallel_residual: bool = False  # GPT-NeoX style
+    layernorm_epsilon: float = 1e-5
+    tie_embeddings: bool = True
+    use_bias: bool = True
+    attn_impl: str = "xla"  # xla | flash | ring
+    remat: bool = False  # activation checkpointing over the layer scan
+    remat_policy: str = "nothing_saveable"
+    dtype: Any = jnp.float32  # compute dtype (params always stored fp32)
+    moe_every: int = 0  # >0: every Nth layer is an MoE FFN (see moe/)
+    num_experts: int = 1
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Parameter init + logical axes
+# ---------------------------------------------------------------------------
+
+def _dense_init(key, shape, fan_in):
+    return (jax.random.normal(key, shape) * (1.0 / math.sqrt(fan_in))).astype(jnp.float32)
+
+
+def init(cfg: TransformerConfig, rng: jax.Array) -> Params:
+    keys = jax.random.split(rng, 16)
+    d, f, L = cfg.hidden_size, cfg.ffn_size, cfg.num_layers
+    H, Dh = cfg.num_heads, cfg.head_dim
+
+    def stack(key, shape, fan_in):
+        ks = jax.random.split(key, L)
+        return jnp.stack([_dense_init(k, shape, fan_in) for k in ks])
+
+    layers = {
+        "ln1_scale": jnp.ones((L, d)),
+        "ln1_bias": jnp.zeros((L, d)),
+        "ln2_scale": jnp.ones((L, d)),
+        "ln2_bias": jnp.zeros((L, d)),
+        "wq": stack(keys[0], (d, H, Dh), d),
+        "wk": stack(keys[1], (d, H, Dh), d),
+        "wv": stack(keys[2], (d, H, Dh), d),
+        "wo": stack(keys[3], (H, Dh, d), d),
+        "wi": stack(keys[4], (d, f), d),
+        "wo_mlp": stack(keys[5], (f, d), f),
+    }
+    if cfg.use_bias:
+        layers.update(
+            {
+                "bq": jnp.zeros((L, H, Dh)),
+                "bk": jnp.zeros((L, H, Dh)),
+                "bv": jnp.zeros((L, H, Dh)),
+                "bo": jnp.zeros((L, d)),
+                "bi": jnp.zeros((L, f)),
+                "bo_mlp": jnp.zeros((L, d)),
+            }
+        )
+    params = {
+        "wte": jax.random.normal(keys[6], (cfg.vocab_size, d)) * 0.02,
+        "layers": layers,
+        "lnf_scale": jnp.ones((d,)),
+        "lnf_bias": jnp.zeros((d,)),
+    }
+    if cfg.pos_emb == "learned":
+        params["wpe"] = jax.random.normal(keys[7], (cfg.max_seq_len, d)) * 0.01
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _dense_init(keys[8], (d, cfg.vocab_size), d)
+    return params
+
+
+def logical_axes(cfg: TransformerConfig) -> Params:
+    """Pytree of logical-axis tuples matching ``init``'s output; consumed by
+    parallel/sharding.spec_from_logical."""
+    layers = {
+        "ln1_scale": ("layers", "embed"),
+        "ln1_bias": ("layers", "embed"),
+        "ln2_scale": ("layers", "embed"),
+        "ln2_bias": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "kv"),
+        "wk": ("layers", "embed", "heads", "kv"),
+        "wv": ("layers", "embed", "heads", "kv"),
+        "wo": ("layers", "heads", "kv", "embed"),
+        "wi": ("layers", "embed", "mlp"),
+        "wo_mlp": ("layers", "mlp", "embed"),
+    }
+    if cfg.use_bias:
+        layers.update(
+            {
+                "bq": ("layers", "heads", "kv"),
+                "bk": ("layers", "heads", "kv"),
+                "bv": ("layers", "heads", "kv"),
+                "bo": ("layers", "embed"),
+                "bi": ("layers", "mlp"),
+                "bo_mlp": ("layers", "embed"),
+            }
+        )
+    axes = {
+        "wte": ("vocab", "embed"),
+        "layers": layers,
+        "lnf_scale": ("embed",),
+        "lnf_bias": ("embed",),
+    }
+    if cfg.pos_emb == "learned":
+        axes["wpe"] = (None, "embed")
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    out = (x32 - mu) * lax.rsqrt(var + eps) * scale + bias
+    return out.astype(x.dtype)
+
+
+def rotary_embed(x, positions, rotary_dims):
+    """Apply rotary position embedding to the first ``rotary_dims`` of x
+    [B, S, H, Dh] (reference inference kernel: apply_rotary_pos_emb,
+    csrc/transformer/inference/csrc/pt_binding.cpp:1268)."""
+    rd = rotary_dims
+    x_rot, x_pass = x[..., :rd], x[..., rd:]
+    half = rd // 2
+    freqs = jnp.exp(-math.log(10000.0) * jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x_rot[..., :half], x_rot[..., half:]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return jnp.concatenate([rotated, x_pass], axis=-1)
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """BLOOM alibi slopes (reference builds these for the BLOOM policy path)."""
+    closest = 2 ** math.floor(math.log2(num_heads))
+    base = jnp.asarray([2 ** (-8.0 * (i + 1) / closest) for i in range(closest)])
+    if closest < num_heads:
+        extra = jnp.asarray(
+            [2 ** (-4.0 * (i + 1) / closest) for i in range(num_heads - closest)]
+        )
+        base = jnp.concatenate([base, extra])
+    return base
+
+
+def xla_attention(q, k, v, *, causal_offset=0, bias=None, dtype=jnp.float32):
+    """Plain einsum attention [B,S,H,Dh] — the baseline the Pallas flash
+    kernel is validated against (mirrors tests vs vendored BERT in the
+    reference's test_cuda_forward.py strategy)."""
+    B, Sq, H, Dh = q.shape
+    Sk = k.shape[1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(Dh)
+    if bias is not None:
+        scores = scores + bias
+    q_pos = jnp.arange(Sq)[:, None] + causal_offset
+    k_pos = jnp.arange(Sk)[None, :]
+    mask = q_pos >= k_pos
+    scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _attention_dispatch(cfg: TransformerConfig):
+    if cfg.attn_impl == "flash":
+        from ..ops.pallas.flash_attention import flash_attention
+
+        return lambda q, k, v, bias: flash_attention(q, k, v, causal=True, bias=bias)
+    if cfg.attn_impl == "ring":
+        from ..parallel.ring_attention import ring_attention
+
+        return lambda q, k, v, bias: ring_attention(q, k, v, axis_name="context")
+    return lambda q, k, v, bias: xla_attention(q, k, v, bias=bias)
+
+
+def _ffn(cfg, lp, h):
+    u = jnp.einsum("bsd,df->bsf", h, lp["wi"].astype(h.dtype))
+    if cfg.use_bias:
+        u = u + lp["bi"].astype(h.dtype)
+    u = jax.nn.gelu(u, approximate=True)
+    out = jnp.einsum("bsf,fd->bsd", u, lp["wo_mlp"].astype(h.dtype))
+    if cfg.use_bias:
+        out = out + lp["bo_mlp"].astype(h.dtype)
+    return out
+
+
+def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, positions):
+    x = carry  # [B, S, d] compute dtype
+    h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(h.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(h.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(h.dtype))
+    if cfg.use_bias:
+        q = q + lp["bq"].astype(h.dtype)
+        k = k + lp["bk"].astype(h.dtype)
+        v = v + lp["bv"].astype(h.dtype)
+    if cfg.pos_emb == "rotary":
+        rd = int(cfg.head_dim * cfg.rotary_pct)
+        q = rotary_embed(q, positions, rd)
+        k = rotary_embed(k, positions, rd)
+    attn_out = attn_fn(q, k, v, alibi_bias)
+    attn_out = jnp.einsum("bshk,hkd->bsd", attn_out, lp["wo"].astype(h.dtype))
+    if cfg.use_bias:
+        attn_out = attn_out + lp["bo"].astype(h.dtype)
+
+    if cfg.parallel_residual:
+        h2 = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_epsilon)
+        x = x + attn_out + _ffn(cfg, lp, h2)
+    else:
+        x = x + attn_out
+        h2 = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_epsilon)
+        x = x + _ffn(cfg, lp, h2)
+    return x, None
+
+
+def apply(cfg: TransformerConfig, params: Params, tokens: jnp.ndarray, positions=None) -> jnp.ndarray:
+    """tokens [B, S] int32 -> logits [B, S, vocab] (fp32)."""
+    B, S = tokens.shape
+    dtype = cfg.dtype
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    x = params["wte"][tokens].astype(dtype)
+    if cfg.pos_emb == "learned":
+        x = x + params["wpe"][positions].astype(dtype)
+
+    bias = None
+    if cfg.pos_emb == "alibi":
+        slopes = alibi_slopes(cfg.num_heads)
+        dist = jnp.arange(S)[None, :] - jnp.arange(S)[:, None]
+        bias = (slopes[:, None, None] * dist[None]).astype(jnp.float32)[None]  # [1,H,S,S]
+
+    attn_fn = _attention_dispatch(cfg)
+    body = partial(_layer_body, cfg, attn_fn, alibi_bias=bias, positions=positions)
+
+    def scan_body(carry, lp):
+        return body(carry, lp)
+
+    if cfg.remat:
+        policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+        scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
+
+    if cfg.moe_every > 0:
+        # MoE layers break scan uniformity; loop layer-by-layer instead.
+        from ..moe.layer import moe_ffn_apply
+
+        L = cfg.num_layers
+        for i in range(L):
+            lp = jax.tree.map(lambda a: a[i], params["layers"])
+            if (i + 1) % cfg.moe_every == 0 and "moe" in params:
+                moe_p = jax.tree.map(lambda a: a[(i + 1) // cfg.moe_every - 1], params["moe"])
+                x = _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions)
+            else:
+                x, _ = body(x, lp)
+    else:
+        x, _ = lax.scan(scan_body, x, params["layers"])
+
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["wte"].T
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
+    return logits.astype(jnp.float32)
+
+
+def _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions):
+    from ..moe.layer import moe_ffn_apply
+
+    h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(h.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(h.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(h.dtype))
+    if cfg.use_bias:
+        q, k, v = q + lp["bq"].astype(h.dtype), k + lp["bk"].astype(h.dtype), v + lp["bv"].astype(h.dtype)
+    if cfg.pos_emb == "rotary":
+        rd = int(cfg.head_dim * cfg.rotary_pct)
+        q, k = rotary_embed(q, positions, rd), rotary_embed(k, positions, rd)
+    attn_out = jnp.einsum("bshk,hkd->bsd", attn_fn(q, k, v, bias), lp["wo"].astype(h.dtype))
+    if cfg.use_bias:
+        attn_out = attn_out + lp["bo"].astype(h.dtype)
+    x = x + attn_out
+    h2 = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_epsilon)
+    moe_out, aux_loss = moe_ffn_apply(cfg, moe_p, h2)
+    return x + moe_out
+
+
+# ---------------------------------------------------------------------------
+# Loss
+# ---------------------------------------------------------------------------
+
+def causal_lm_loss(cfg: TransformerConfig, params: Params, batch: dict) -> jnp.ndarray:
+    """Next-token cross-entropy. batch: {'tokens': [B,S]} or
+    {'input_ids': ..., 'labels': ...} (HF spelling accepted)."""
+    tokens = batch.get("tokens", batch.get("input_ids"))
+    labels = batch.get("labels")
+    if labels is None:
+        inputs, labels = tokens[:, :-1], tokens[:, 1:]
+    else:
+        inputs = tokens
+    logits = apply(cfg, params, inputs)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    mask = (labels >= 0).astype(jnp.float32)
+    nll = (logz - gold) * mask
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+class Model:
+    """Thin bundle handed to ``deepspeed_tpu.initialize``: init/apply/loss +
+    logical axes (the engine's contract; see runtime/engine.py)."""
+
+    def __init__(self, cfg: TransformerConfig, loss_fn: Optional[Callable] = None):
+        self.config = cfg
+        self._loss = loss_fn or causal_lm_loss
+
+    def init(self, rng):
+        return init(self.config, rng)
+
+    def apply(self, params, *args, **kw):
+        return apply(self.config, params, *args, **kw)
+
+    def loss(self, params, batch):
+        return self._loss(self.config, params, batch)
+
+    def logical_axes(self):
+        return logical_axes(self.config)
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs/token (fwd+bwd ≈ 6 * n_params matmul
+        + attention term) — used by the throughput reports (reference:
+        ThroughputTimer TFLOPS estimate utils/timer.py:135)."""
+        c = self.config
+        n_params = (
+            c.num_layers * (4 * c.hidden_size * c.hidden_size + 2 * c.hidden_size * c.ffn_size)
+            + c.vocab_size * c.hidden_size
+        )
+        attn = c.num_layers * 2 * c.max_seq_len * c.hidden_size  # per-token qk+av
+        return 6.0 * (n_params + attn)

@@ -1,0 +1,107 @@
+"""Monitoring backends behind one ``MonitorMaster``
+(reference: monitor/monitor.py:24 + monitor/{tensorboard,wandb,csv_monitor}.py).
+
+Events are ``(tag, value, step)`` triples; each enabled backend receives every
+event. TensorBoard/W&B imports are soft — missing packages disable the backend
+with a warning instead of failing (same availability-gating the reference
+applies to optional ops)."""
+
+from __future__ import annotations
+
+import os
+
+from ..utils.logging import logger
+
+
+class _Backend:
+    enabled = False
+
+    def write_events(self, events):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(_Backend):
+    def __init__(self, cfg):
+        self.enabled = False
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            out = os.path.join(cfg.output_path or "./runs", cfg.job_name)
+            self.writer = SummaryWriter(log_dir=out)
+            self.enabled = True
+        except Exception as e:  # tensorboard optional
+            logger.warning(f"tensorboard monitor disabled: {e}")
+
+    def write_events(self, events):
+        for tag, value, step in events:
+            self.writer.add_scalar(tag, value, step)
+        self.writer.flush()
+
+
+class WandbMonitor(_Backend):
+    def __init__(self, cfg):
+        self.enabled = False
+        try:
+            import wandb
+
+            wandb.init(project=cfg.project, group=cfg.group or None, team=cfg.team or None)
+            self.wandb = wandb
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"wandb monitor disabled: {e}")
+
+    def write_events(self, events):
+        for tag, value, step in events:
+            self.wandb.log({tag: value}, step=step)
+
+
+class CsvMonitor(_Backend):
+    def __init__(self, cfg):
+        self.dir = os.path.join(cfg.output_path or "./csv_logs", cfg.job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.files = {}
+        self.enabled = True
+
+    def write_events(self, events):
+        import csv
+
+        for tag, value, step in events:
+            fname = os.path.join(self.dir, tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([step, value])
+
+
+class MonitorMaster:
+    """Fan-out of (tag, value, step) events (reference: monitor/monitor.py:24).
+    Only process 0 writes, matching the reference's rank-0 gating."""
+
+    def __init__(self, ds_config):
+        import jax
+
+        self.backends = []
+        if jax.process_index() != 0:
+            return
+        if ds_config.tensorboard.enabled:
+            b = TensorBoardMonitor(ds_config.tensorboard)
+            if b.enabled:
+                self.backends.append(b)
+        if ds_config.wandb.enabled:
+            b = WandbMonitor(ds_config.wandb)
+            if b.enabled:
+                self.backends.append(b)
+        if ds_config.csv_monitor.enabled:
+            b = CsvMonitor(ds_config.csv_monitor)
+            if b.enabled:
+                self.backends.append(b)
+
+    @property
+    def enabled(self):
+        return bool(self.backends)
+
+    def write_events(self, events):
+        for b in self.backends:
+            b.write_events(events)

@@ -1,0 +1,143 @@
+"""Logical-axis sharding rules — how ZeRO stages & TP become PartitionSpecs.
+
+The reference implements ZeRO with an eager partitioning runtime: flat fp16
+buffers split across DP ranks (stage 1/2, runtime/zero/stage_1_and_2.py:93) and
+per-parameter shards with a fetch/prefetch coordinator (stage 3,
+runtime/zero/stage3.py:66 + partitioned_param_coordinator.py:44). On TPU the
+same *placement decisions* are expressed declaratively: every model parameter
+carries a tuple of logical axis names; a rule table maps logical names to mesh
+axes; XLA's SPMD partitioner then derives the all-gathers and reduce-scatters
+the reference hand-schedules.
+
+Stage → rule mapping (SURVEY.md §7):
+  stage 0: params/grads/opt replicated (grads psum'd by pjit)
+  stage 1: params replicated; optimizer state sharded over (data, fsdp)
+  stage 2: + gradients reduce-scattered onto the same shards
+  stage 3: params themselves sharded over fsdp (+data if fsdp axis is 1)
+Tensor parallelism composes by mapping width logical axes ('heads', 'mlp',
+'vocab') onto 'model' first; the ZeRO axis then takes a remaining dimension.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MeshAxes = Union[None, str, tuple[str, ...]]
+Rules = Sequence[tuple[str, MeshAxes]]
+
+# Default logical-axis → mesh-axis table for transformer models.
+# 'model' = tensor parallel; 'fsdp' = ZeRO-3 axis; None = replicated.
+DEFAULT_TP_RULES: Rules = (
+    ("vocab", "model"),
+    ("heads", "model"),
+    ("kv", None),
+    ("mlp", "model"),
+    ("ffn_in", "model"),
+    ("embed", None),
+    ("layers", None),
+    ("expert", "expert"),
+    ("context", "context"),
+    ("batch", ("data", "fsdp")),
+)
+
+
+def _axis_sizes(mesh: Mesh) -> dict[str, int]:
+    return dict(mesh.shape)
+
+
+def _mesh_axes_size(mesh: Mesh, axes: MeshAxes) -> int:
+    if axes is None:
+        return 1
+    if isinstance(axes, str):
+        axes = (axes,)
+    return int(np.prod([mesh.shape.get(a, 1) for a in axes]))
+
+
+def spec_from_logical(
+    logical_axes: Optional[tuple],
+    shape: tuple[int, ...],
+    rules: Rules,
+    mesh: Mesh,
+) -> PartitionSpec:
+    """Map one parameter's logical axes to a PartitionSpec, skipping any mesh
+    axis that does not divide the dimension (reference analogue: padding of
+    the flat partition buffers, stage_1_and_2.py:562 — we instead replicate
+    non-divisible dims, which XLA handles without padding)."""
+    if logical_axes is None:
+        return PartitionSpec()
+    assert len(logical_axes) == len(shape), f"{logical_axes} vs {shape}"
+    table = dict(rules)
+    used: set[str] = set()
+    out = []
+    for name, dim in zip(logical_axes, shape):
+        axes = table.get(name)
+        if axes is None:
+            out.append(None)
+            continue
+        if isinstance(axes, str):
+            axes = (axes,)
+        axes = tuple(a for a in axes if a in mesh.shape and a not in used)
+        size = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
+        if axes and size > 1 and dim % size == 0:
+            used.update(axes)
+            out.append(axes if len(axes) > 1 else axes[0])
+        else:
+            out.append(None)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def zero_stage_rules(stage: int, base: Rules = DEFAULT_TP_RULES) -> tuple[Rules, Rules]:
+    """Return (param_rules, optstate_rules) for a ZeRO stage.
+
+    Parameters follow ``param_rules``; optimizer state (fp32 master weights,
+    Adam moments) follows ``optstate_rules``. For stages 1/2 the optimizer
+    state additionally shards its 'embed'/widest free axis over (fsdp, data)
+    while params stay replicated — exactly the reference's split of "model
+    state" vs "optimizer state" placement (stage_1_and_2.py:93 docstring).
+    """
+    base = tuple(base)
+    if stage == 0:
+        return base, base
+    # opt-state rules: put the ZeRO axis on 'embed' (every matrix/vector in a
+    # transformer has an embed-like dim; it is rarely TP-sharded).
+    zero_axes = ("fsdp", "data")
+    opt = tuple((k, zero_axes) if k == "embed" else (k, v) for k, v in base)
+    if stage < 3:
+        return base, opt
+    # stage 3: params themselves are sharded (FSDP).
+    return opt, opt
+
+
+def make_param_specs(logical_axes_tree, shapes_tree, rules: Rules, mesh: Mesh):
+    """Tree-map ``spec_from_logical`` over a model's parameter pytree."""
+    return jax.tree.map(
+        lambda ax, shp: spec_from_logical(ax, tuple(shp), rules, mesh),
+        logical_axes_tree,
+        shapes_tree,
+        is_leaf=lambda x: x is None or (isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x)),
+    )
+
+
+def tree_shardings(mesh: Mesh, specs_tree):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+def constrain(tree, mesh: Mesh, specs_tree):
+    """with_sharding_constraint over a pytree (inside jit)."""
+    flat_x, treedef = jax.tree.flatten(tree)
+    flat_s = treedef.flatten_up_to(specs_tree)
+    out = [
+        jax.lax.with_sharding_constraint(x, NamedSharding(mesh, s)) if isinstance(s, PartitionSpec) else x
+        for x, s in zip(flat_x, flat_s)
+    ]
+    return jax.tree.unflatten(treedef, out)

@@ -1,0 +1,407 @@
+"""JSON config → typed config tree.
+
+TPU-native re-design of ``DeepSpeedConfig`` (reference: runtime/config.py:755).
+The reference mixes two schema generations (hand-rolled ``get_scalar_param``
+readers and pydantic models, runtime/config_utils.py); here there is a single
+generation of dataclasses from day one (SURVEY.md §5 "Config / flag system").
+User-facing JSON keys keep DeepSpeed spelling so existing configs load
+unchanged — including batch-size triangulation
+(train = micro × gas × dp_world, reference runtime/config.py:846-905).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from . import constants as C
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+def _sub(d: dict, key: str) -> dict:
+    v = d.get(key, {})
+    if v is None:
+        return {}
+    if not isinstance(v, dict):
+        raise DeepSpeedConfigError(f"'{key}' must be an object, got {type(v)}")
+    return v
+
+
+def _filter_kwargs(cls, d: dict) -> dict:
+    names = {f.name for f in dataclasses.fields(cls)}
+    return {k: v for k, v in d.items() if k in names}
+
+
+def _build(cls, d: dict):
+    return cls(**_filter_kwargs(cls, d))
+
+
+@dataclass
+class FP16Config:
+    """reference: runtime/config.py fp16 block + fp16/loss_scaler.py."""
+
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+
+@dataclass
+class BF16Config:
+    enabled: bool = False
+
+
+@dataclass
+class OffloadConfig:
+    """zero offload sub-configs (reference: runtime/zero/offload_config.py)."""
+
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: str = "/tmp/dstpu_nvme"
+    pin_memory: bool = True
+    buffer_count: int = 4
+    fast_init: bool = False
+
+
+@dataclass
+class ZeroConfig:
+    """reference: runtime/zero/config.py:77 DeepSpeedZeroConfig.
+
+    On TPU the stage number selects a *sharding rule set*, not a hand-managed
+    partitioning runtime (SURVEY.md §7):
+      0: replicated params/grads/opt state, psum grads
+      1: optimizer state sharded over (data, fsdp)
+      2: + gradients reduce-scattered
+      3: + parameters sharded (FSDP); XLA all-gathers at use
+    """
+
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 5e8
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 5e8
+    overlap_comm: bool = True
+    round_robin_gradients: bool = False
+    offload_param: OffloadConfig = field(default_factory=OffloadConfig)
+    offload_optimizer: OffloadConfig = field(default_factory=OffloadConfig)
+    sub_group_size: int = 1e9
+    prefetch_bucket_size: int = 5e7
+    param_persistence_threshold: int = 1e5
+    max_live_parameters: int = 1e9
+    max_reuse_distance: int = 1e9
+    gather_16bit_weights_on_model_save: bool = False
+    ignore_unused_parameters: bool = True
+    zero_quantized_weights: bool = False
+
+    def __post_init__(self):
+        if isinstance(self.offload_param, dict):
+            self.offload_param = _build(OffloadConfig, self.offload_param)
+        if isinstance(self.offload_optimizer, dict):
+            self.offload_optimizer = _build(OffloadConfig, self.offload_optimizer)
+        if self.stage not in (0, 1, 2, 3):
+            raise DeepSpeedConfigError(f"zero stage must be 0-3, got {self.stage}")
+
+
+@dataclass
+class OptimizerConfig:
+    type: str = "adamw"
+    params: dict = field(default_factory=dict)
+
+
+@dataclass
+class SchedulerConfig:
+    type: Optional[str] = None
+    params: dict = field(default_factory=dict)
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    """reference: runtime/activation_checkpointing/checkpointing.py:825 configure().
+
+    On TPU this maps to jax.checkpoint policies over the scanned layer stack;
+    partition_activations maps to sharding the residual stream over 'model'.
+    """
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-only: jax.checkpoint policy name (see runtime/checkpointing.py)
+    policy: str = "nothing_saveable"
+    enabled: bool = False
+
+
+@dataclass
+class FlopsProfilerConfig:
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@dataclass
+class CommsLoggerConfig:
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+
+
+@dataclass
+class MonitorBackendConfig:
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+    team: str = ""
+    group: str = ""
+    project: str = "deepspeed"
+
+
+@dataclass
+class CurriculumConfig:
+    """reference: runtime/data_pipeline/curriculum_scheduler.py:8."""
+
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: dict = field(default_factory=dict)
+
+
+@dataclass
+class ProgressiveLayerDropConfig:
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+@dataclass
+class EigenvalueConfig:
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "bert.encoder.layer"
+    layer_num: int = 0
+
+
+@dataclass
+class AioConfig:
+    """reference: runtime/swap_tensor/aio_config.py."""
+
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+@dataclass
+class SparseAttentionConfig:
+    """reference: runtime/config.py:283-466 sparse attention modes."""
+
+    mode: str = "fixed"
+    block: int = 16
+    different_layout_per_head: bool = False
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+    attention: str = "bidirectional"
+    horizontal_global_attention: bool = False
+    num_different_global_patterns: int = 1
+    num_random_blocks: int = 0
+    local_window_blocks: list = field(default_factory=lambda: [4])
+    global_block_indices: list = field(default_factory=lambda: [0])
+    global_block_end_indices: Optional[list] = None
+    num_sliding_window_blocks: int = 3
+
+
+@dataclass
+class MeshAxesConfig:
+    """TPU-only: logical mesh shape. -1 = remainder (at most one axis)."""
+
+    pipe: int = 1
+    data: int = -1
+    fsdp: int = 1
+    context: int = 1
+    model: int = 1
+
+
+@dataclass
+class CheckpointConfig:
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+
+
+@dataclass
+class ElasticityConfig:
+    """reference: elasticity/config.py + elasticity.py:287."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: list = field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.1
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+@dataclass
+class DeepSpeedConfig:
+    """Top-level typed config. Entry point: ``DeepSpeedConfig.from_dict`` /
+    ``from_file`` (reference ctor runtime/config.py:755 takes json path/dict).
+    """
+
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+    steps_per_print: int = C.STEPS_PER_PRINT_DEFAULT
+    gradient_clipping: float = C.GRADIENT_CLIPPING_DEFAULT
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    sparse_gradients: bool = False
+    dataloader_drop_last: bool = False
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    dump_state: bool = False
+
+    fp16: FP16Config = field(default_factory=FP16Config)
+    bf16: BF16Config = field(default_factory=BF16Config)
+    zero_optimization: ZeroConfig = field(default_factory=ZeroConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = field(default_factory=ActivationCheckpointingConfig)
+    flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
+    comms_logger: CommsLoggerConfig = field(default_factory=CommsLoggerConfig)
+    tensorboard: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    curriculum_learning: CurriculumConfig = field(default_factory=CurriculumConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = field(default_factory=ProgressiveLayerDropConfig)
+    eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
+    aio: AioConfig = field(default_factory=AioConfig)
+    sparse_attention: Optional[SparseAttentionConfig] = None
+    mesh: MeshAxesConfig = field(default_factory=MeshAxesConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
+
+    raw: dict = field(default_factory=dict, repr=False)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_file(cls, path: str, world_size: int = 1) -> "DeepSpeedConfig":
+        with open(path) as f:
+            return cls.from_dict(json.load(f), world_size=world_size)
+
+    @classmethod
+    def from_dict(cls, d: dict, world_size: int = 1) -> "DeepSpeedConfig":
+        cfg = cls(
+            train_batch_size=d.get(C.TRAIN_BATCH_SIZE),
+            train_micro_batch_size_per_gpu=d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU),
+            gradient_accumulation_steps=d.get(C.GRADIENT_ACCUMULATION_STEPS),
+            steps_per_print=d.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT),
+            gradient_clipping=d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT),
+            prescale_gradients=d.get(C.PRESCALE_GRADIENTS, False),
+            gradient_predivide_factor=d.get(C.GRADIENT_PREDIVIDE_FACTOR, 1.0),
+            sparse_gradients=d.get(C.SPARSE_GRADIENTS, False),
+            dataloader_drop_last=d.get(C.DATALOADER_DROP_LAST, False),
+            wall_clock_breakdown=d.get(C.WALL_CLOCK_BREAKDOWN, False),
+            memory_breakdown=d.get(C.MEMORY_BREAKDOWN, False),
+            dump_state=d.get(C.DUMP_STATE, False),
+            fp16=_build(FP16Config, _sub(d, C.FP16)),
+            bf16=_build(BF16Config, _sub(d, C.BF16)),
+            zero_optimization=_build(ZeroConfig, _sub(d, C.ZERO_OPTIMIZATION)),
+            optimizer=_build(OptimizerConfig, _sub(d, C.OPTIMIZER)),
+            scheduler=_build(SchedulerConfig, _sub(d, C.SCHEDULER)),
+            activation_checkpointing=_build(ActivationCheckpointingConfig, _sub(d, C.ACTIVATION_CHECKPOINTING)),
+            flops_profiler=_build(FlopsProfilerConfig, _sub(d, C.FLOPS_PROFILER)),
+            comms_logger=_build(CommsLoggerConfig, _sub(d, C.COMMS_LOGGER)),
+            tensorboard=_build(MonitorBackendConfig, _sub(d, C.MONITOR_TENSORBOARD)),
+            wandb=_build(MonitorBackendConfig, _sub(d, C.MONITOR_WANDB)),
+            csv_monitor=_build(MonitorBackendConfig, _sub(d, C.MONITOR_CSV)),
+            curriculum_learning=_build(CurriculumConfig, _sub(d, C.CURRICULUM_LEARNING)),
+            progressive_layer_drop=_build(ProgressiveLayerDropConfig, _sub(d, C.PROGRESSIVE_LAYER_DROP)),
+            eigenvalue=_build(EigenvalueConfig, _sub(d, "eigenvalue")),
+            aio=_build(AioConfig, _sub(d, C.AIO)),
+            sparse_attention=(_build(SparseAttentionConfig, d[C.SPARSE_ATTENTION]) if d.get(C.SPARSE_ATTENTION) else None),
+            mesh=_build(MeshAxesConfig, _sub(d, C.MESH)),
+            checkpoint=_build(CheckpointConfig, _sub(d, C.CHECKPOINT)),
+            elasticity=_build(ElasticityConfig, _sub(d, C.ELASTICITY)),
+            raw=d,
+        )
+        cfg._triangulate_batch(world_size)
+        cfg._validate()
+        return cfg
+
+    # ------------------------------------------------------------------
+    def _triangulate_batch(self, world_size: int) -> None:
+        """train = micro × gas × dp_world (reference runtime/config.py:846)."""
+        train, micro, gas = (
+            self.train_batch_size,
+            self.train_micro_batch_size_per_gpu,
+            self.gradient_accumulation_steps,
+        )
+        ws = max(world_size, 1)
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * ws)
+        elif train is not None and gas is not None:
+            micro = train // (gas * ws)
+        elif micro is not None and gas is not None:
+            train = micro * gas * ws
+        elif train is not None:
+            gas = 1
+            micro = train // ws
+        elif micro is not None:
+            train = micro * ws
+            gas = 1
+        else:
+            raise DeepSpeedConfigError(
+                "at least one of train_batch_size / train_micro_batch_size_per_gpu must be set"
+            )
+        self.train_batch_size, self.train_micro_batch_size_per_gpu, self.gradient_accumulation_steps = train, micro, gas
+        if train != micro * gas * ws:
+            raise DeepSpeedConfigError(
+                f"batch sizes inconsistent: train_batch_size={train} != "
+                f"micro({micro}) * gas({gas}) * world({ws})"
+            )
+
+    def _validate(self) -> None:
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        if self.zero_optimization.stage > 0 and not (self.fp16.enabled or self.bf16.enabled):
+            # ZeRO with fp32 is allowed (reference warns); keep permissive.
+            pass
+
+    # Convenience accessors matching the reference engine's names.
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_optimization.stage > 0
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32

@@ -1,0 +1,59 @@
+"""Distributed-aware logging.
+
+TPU-native analogue of the reference's ``deepspeed/utils/logging.py``: a module
+logger plus ``log_dist(ranks=...)`` which only emits on the named JAX process
+indices (reference: utils/logging.py:48 ``log_dist``).
+"""
+
+import logging
+import os
+import sys
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+def create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    if not lg.handlers:
+        lg.setLevel(level)
+        lg.propagate = False
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(logging.Formatter(LOG_FORMAT))
+        lg.addHandler(handler)
+    env_level = os.environ.get("DSTPU_LOG_LEVEL")
+    if env_level:
+        lg.setLevel(getattr(logging, env_level.upper(), level))
+    return lg
+
+
+logger = create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process indices (-1 or None = all)."""
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_json_dist(message: dict, ranks=None, path: str | None = None) -> None:
+    """Dump a metrics dict as JSON on the given ranks (reference: utils/logging.py:74)."""
+    import json
+
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        message["rank"] = my_rank
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(message, f)
+        else:
+            logger.info(json.dumps(message))

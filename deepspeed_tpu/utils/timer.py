@@ -1,0 +1,132 @@
+"""Wall-clock and throughput timers.
+
+TPU-native analogue of ``deepspeed/utils/timer.py``: the reference uses CUDA
+events for device-accurate timing (utils/timer.py:20 CudaEventTimer); on TPU we
+bracket timed regions with ``jax.block_until_ready`` on a sentinel array, which
+drains the dispatch queue the same way an event sync drains a stream.
+"""
+
+import time
+
+from .logging import logger
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = 0.0
+        self.count = 0
+
+    def start(self, barrier_array=None):
+        assert not self.started_, f"timer {self.name} already started"
+        if barrier_array is not None:
+            import jax
+
+            jax.block_until_ready(barrier_array)
+        self.start_time = time.perf_counter()
+        self.started_ = True
+
+    def stop(self, barrier_array=None):
+        assert self.started_, f"timer {self.name} not started"
+        if barrier_array is not None:
+            import jax
+
+            jax.block_until_ready(barrier_array)
+        self.elapsed_ += time.perf_counter() - self.start_time
+        self.count += 1
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.count = 0
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        val = self.elapsed_
+        if reset:
+            self.reset()
+        return val
+
+    def mean(self) -> float:
+        return self.elapsed_ / max(self.count, 1)
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry (reference: utils/timer.py:31)."""
+
+    def __init__(self):
+        self.timers: dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    @staticmethod
+    def memory_usage() -> str:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0) / (1024**3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+            return f"device mem in-use {in_use:.2f} GB | peak {peak:.2f} GB"
+        except Exception:
+            return "device mem stats unavailable"
+
+    def log(self, names, normalizer: float = 1.0, reset: bool = True, memory_breakdown=False):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
+        logger.info(string)
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPS estimate (reference: utils/timer.py:135)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50, monitor_memory: bool = False):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.epoch_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.started = False
+        self.start_time = 0.0
+
+    def start(self):
+        self.start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, global_step: bool = True, report_speed: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        if global_step:
+            self.global_step_count += 1
+        duration = time.perf_counter() - self.start_time
+        if self.global_step_count > self.start_step:
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                logger.info(
+                    f"step={self.global_step_count}, "
+                    f"samples/sec={self.avg_samples_per_sec():.2f}, "
+                    f"curr samples/sec={self.batch_size * self.steps_per_output / max(self.step_elapsed_time, 1e-9):.2f}"
+                )
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step:
+            steps = self.global_step_count - self.start_step
+            return self.batch_size / (self.total_elapsed_time / max(steps, 1))
+        return -1.0

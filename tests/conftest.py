@@ -1,0 +1,33 @@
+"""Test harness: 8 virtual CPU devices stand in for a TPU slice.
+
+The reference simulates "multi-node" as multi-process single-node NCCL
+(tests/unit/common.py:66 DistributedTest). The TPU-native analogue is simpler:
+one process with N XLA host-platform devices, meshes built over them exactly
+as on a pod (SURVEY.md §4 "portable lessons" (a))."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The image's sitecustomize may have force-selected the TPU platform via
+# jax.config; tests always run on the 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture
+def mesh8():
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(data=-1))
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,91 @@
+"""Config parsing tests (mirrors reference tests/unit/test_config.py scope:
+batch triangulation, zero config, fp16/bf16 exclusivity)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_triangulation_full():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+        world_size=4,
+    )
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triangulation_infer_gas():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4}, world_size=4
+    )
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triangulation_infer_train():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2}, world_size=2
+    )
+    assert cfg.train_batch_size == 16
+
+
+def test_batch_inconsistent_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.from_dict(
+            {"train_batch_size": 33, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
+            world_size=4,
+        )
+
+
+def test_no_batch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.from_dict({}, world_size=1)
+
+
+def test_zero_config():
+    cfg = DeepSpeedConfig.from_dict(
+        {
+            "train_batch_size": 8,
+            "zero_optimization": {"stage": 3, "offload_optimizer": {"device": "cpu"}},
+            "bf16": {"enabled": True},
+        },
+        world_size=1,
+    )
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_optimization.offload_optimizer.device == "cpu"
+    assert cfg.zero_enabled
+
+
+def test_zero_bad_stage():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.from_dict(
+            {"train_batch_size": 8, "zero_optimization": {"stage": 7}}, world_size=1
+        )
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.from_dict(
+            {"train_batch_size": 8, "fp16": {"enabled": True}, "bf16": {"enabled": True}},
+            world_size=1,
+        )
+
+
+def test_compute_dtype():
+    import jax.numpy as jnp
+
+    cfg = DeepSpeedConfig.from_dict({"train_batch_size": 8, "bf16": {"enabled": True}}, world_size=1)
+    assert cfg.compute_dtype == jnp.bfloat16
+
+
+def test_optimizer_scheduler_blocks():
+    cfg = DeepSpeedConfig.from_dict(
+        {
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "betas": [0.9, 0.95]}},
+            "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+        },
+        world_size=1,
+    )
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.scheduler.type == "WarmupLR"
