@@ -1,0 +1,73 @@
+"""Tiny synthetic models + data for tests — analogue of the reference's
+tests/unit/simple_model.py (SimpleModel + random dataset helpers)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+
+def tiny_transformer(**overrides) -> Model:
+    cfg = TransformerConfig(
+        vocab_size=128,
+        max_seq_len=64,
+        num_layers=2,
+        num_heads=4,
+        hidden_size=64,
+        dtype=jnp.float32,
+    )
+    if overrides:
+        cfg = cfg.replace(**overrides)
+    return Model(cfg)
+
+
+def random_tokens(batch, seq=33, vocab=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"tokens": rng.integers(0, vocab, size=(batch, seq)).astype(np.int32)}
+
+
+class SimpleMLP:
+    """Non-transformer model exercising the engine's model contract
+    (init/apply/loss/logical_axes) — reference SimpleModel analogue."""
+
+    def __init__(self, dim=16, hidden=32):
+        self.dim, self.hidden = dim, hidden
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (self.dim, self.hidden)) * 0.1,
+            "b1": jnp.zeros((self.hidden,)),
+            "w2": jax.random.normal(k2, (self.hidden, self.dim)) * 0.1,
+        }
+
+    def logical_axes(self):
+        return {"w1": ("embed", "mlp"), "b1": ("mlp",), "w2": ("mlp", "embed")}
+
+    def apply(self, params, x):
+        h = jax.nn.relu(x @ params["w1"] + params["b1"])
+        return h @ params["w2"]
+
+    def loss(self, params, batch):
+        pred = self.apply(params, batch["x"])
+        return jnp.mean(jnp.square(pred - batch["y"]))
+
+
+def mlp_batch(batch, dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((batch, dim)).astype(np.float32)
+    return {"x": x, "y": 0.5 * x}
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 100,
+    }
+    cfg.update(over)
+    return cfg
