@@ -1,0 +1,95 @@
+"""Benchmark: GPT-2 125M-class causal-LM training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor: the reference's single-device headline is BERT-large at
+64 TFLOPS/GPU on V100 (BASELINE.md row 1). We report achieved model TFLOPS
+per chip on a decoder-only 125M model (seq 1024, bf16) and vs_baseline =
+achieved_TFLOPS / 64.0.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    # GPT-2 small (125M): 12L, 768h, 12 heads, vocab 50257, seq 1024.
+    if on_tpu:
+        # batch 64 fits in 16 GB HBM thanks to layer remat + chunked LM loss
+        L, H, D, V, S, B = 12, 12, 768, 50304, 1024, 64
+    else:  # CPU smoke fallback so the script always emits a line
+        L, H, D, V, S, B = 2, 4, 128, 1024, 128, 4
+
+    cfg = TransformerConfig(
+        vocab_size=V,
+        max_seq_len=S,
+        num_layers=L,
+        num_heads=H,
+        hidden_size=D,
+        pos_emb="learned",
+        dtype=jnp.bfloat16,
+        remat=on_tpu,  # activation checkpointing over the layer scan
+    )
+    model = Model(cfg)
+    ds_cfg = {
+        "train_batch_size": B,
+        "train_micro_batch_size_per_gpu": B,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000000,
+        "mesh": {"data": -1},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_cfg)
+    tokens = np.random.default_rng(0).integers(0, V, size=(B, S + 1)).astype(np.int32)
+    batch = {"tokens": tokens}
+
+    # warmup (compile)
+    engine.train_batch(batch)
+    jax.block_until_ready(engine.state["params"]["wte"])
+
+    steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.train_batch(batch)
+    jax.block_until_ready(engine.state["params"]["wte"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = B * S
+    tok_s = steps * tokens_per_step / dt
+    n_chips = len(jax.devices())
+    tok_s_chip = tok_s / n_chips
+
+    # 6*N FLOPs/token (fwd+bwd) + attention term
+    n_params = L * (4 * D * D + 8 * D * D) + V * D + S * D
+    attn_flops = L * 12 * S * D  # qk^T + av fwd+bwd per token
+    flops_per_token = 6 * n_params + attn_flops
+    tflops = tok_s_chip * flops_per_token / 1e12
+
+    out = {
+        "metric": "gpt2-125M bf16 train throughput (achieved TFLOPS/chip)",
+        "value": round(tflops, 2),
+        "unit": "TFLOPS/chip",
+        "vs_baseline": round(tflops / 64.0, 3),
+        "tokens_per_sec_per_chip": round(tok_s_chip, 1),
+        "platform": platform,
+        "n_chips": n_chips,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
