@@ -31,6 +31,10 @@ from jax import lax
 
 Params = Any
 
+# Mesh handle for MoE sharding constraints inside traced code (set by
+# Model.set_mesh via the engine; [None] = no constraint, single-mesh apps only).
+_ACTIVE_MESH: list = [None]
+
 
 @dataclass(frozen=True)
 class TransformerConfig:
@@ -54,6 +58,7 @@ class TransformerConfig:
     num_experts: int = 1
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
+    moe_aux_coeff: float = 0.01  # load-balancing loss weight
     loss_chunk_size: int = 512  # chunk the vocab projection in the loss; 0 = off
 
     @property
@@ -118,6 +123,11 @@ def init(cfg: TransformerConfig, rng: jax.Array) -> Params:
         params["wpe"] = jax.random.normal(keys[7], (cfg.max_seq_len, d)) * 0.01
     if not cfg.tie_embeddings:
         params["lm_head"] = _dense_init(keys[8], (d, cfg.vocab_size), d)
+    if cfg.moe_every > 0:
+        from ..moe.layer import init_moe_params
+
+        n_moe = cfg.num_layers // cfg.moe_every
+        params["moe"] = init_moe_params(keys[9], n_moe, cfg.num_experts, d, f)
     return params
 
 
@@ -157,6 +167,10 @@ def logical_axes(cfg: TransformerConfig) -> Params:
         axes["wpe"] = (None, "embed")
     if not cfg.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
+    if cfg.moe_every > 0:
+        from ..moe.layer import moe_logical_axes
+
+        axes["moe"] = moe_logical_axes()
     return axes
 
 
@@ -223,9 +237,9 @@ def _attention_dispatch(cfg: TransformerConfig):
 
         return lambda q, k, v, bias: flash_attention(q, k, v, causal=True, bias=bias)
     if cfg.attn_impl == "ring":
-        from ..parallel.ring_attention import ring_attention
+        from ..parallel.ring_attention import ring_attention_sharded
 
-        return lambda q, k, v, bias: ring_attention(q, k, v, axis_name="context")
+        return lambda q, k, v, bias: ring_attention_sharded(q, k, v, mesh=_ACTIVE_MESH[0])
     return lambda q, k, v, bias: xla_attention(q, k, v, bias=bias)
 
 
@@ -275,9 +289,11 @@ def apply(
     tokens: jnp.ndarray,
     positions=None,
     return_hidden: bool = False,
+    with_aux: bool = False,
 ) -> jnp.ndarray:
     """tokens [B, S] int32 -> logits [B, S, vocab] (fp32), or the final hidden
-    states [B, S, d] when ``return_hidden`` (used by the chunked LM loss)."""
+    states [B, S, d] when ``return_hidden`` (used by the chunked LM loss).
+    With ``with_aux`` returns (out, aux_loss) — MoE load-balancing loss."""
     B, S = tokens.shape
     dtype = cfg.dtype
     if positions is None:
@@ -302,16 +318,16 @@ def apply(
         policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
         scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
 
+    aux_total = jnp.zeros((), jnp.float32)
     if cfg.moe_every > 0:
         # MoE layers break scan uniformity; loop layer-by-layer instead.
-        from ..moe.layer import moe_ffn_apply
-
         L = cfg.num_layers
         for i in range(L):
             lp = jax.tree.map(lambda a: a[i], params["layers"])
             if (i + 1) % cfg.moe_every == 0 and "moe" in params:
                 moe_p = jax.tree.map(lambda a: a[(i + 1) // cfg.moe_every - 1], params["moe"])
-                x = _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions)
+                x, aux = _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions)
+                aux_total = aux_total + aux
             else:
                 x, _ = body(x, lp)
     else:
@@ -319,12 +335,13 @@ def apply(
 
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon)
     if return_hidden:
-        return x
+        return (x, aux_total) if with_aux else x
     head = params.get("lm_head", None)
     if head is None:
         head = params["wte"].T
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    return (logits, aux_total) if with_aux else logits
 
 
 def _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions):
@@ -344,8 +361,8 @@ def _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions):
         attn_out = attn_out + lp["bo"].astype(h.dtype)
     x = x + attn_out
     h2 = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_epsilon)
-    moe_out, aux_loss = moe_ffn_apply(cfg, moe_p, h2)
-    return x + moe_out
+    moe_out, aux_loss = moe_ffn_apply(cfg, moe_p, h2, mesh=_ACTIVE_MESH[0])
+    return x + moe_out, aux_loss
 
 
 # ---------------------------------------------------------------------------
@@ -374,14 +391,14 @@ def causal_lm_loss(cfg: TransformerConfig, params: Params, batch: dict) -> jnp.n
     chunk = cfg.loss_chunk_size
     S = inputs.shape[1]
     if chunk <= 0 or S % chunk != 0 or S <= chunk:
-        logits = apply(cfg, params, inputs)
+        logits, aux = apply(cfg, params, inputs, with_aux=True)
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
         mask = (labels >= 0).astype(jnp.float32)
         nll = (logz - gold) * mask
-        return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0) + cfg.moe_aux_coeff * aux
 
-    hidden = apply(cfg, params, inputs, return_hidden=True)  # [B, S, d]
+    hidden, aux = apply(cfg, params, inputs, return_hidden=True, with_aux=True)  # [B, S, d]
     n_chunks = S // chunk
     h_c = hidden.reshape(hidden.shape[0], n_chunks, chunk, hidden.shape[-1]).swapaxes(0, 1)
     l_c = labels.reshape(labels.shape[0], n_chunks, chunk).swapaxes(0, 1)
@@ -397,7 +414,7 @@ def causal_lm_loss(cfg: TransformerConfig, params: Params, batch: dict) -> jnp.n
         return (nll_sum + jnp.sum((logz - gold) * mask), tok_sum + jnp.sum(mask)), None
 
     (nll_sum, tok_sum), _ = lax.scan(chunk_loss, (jnp.zeros(()), jnp.zeros(())), (h_c, l_c))
-    return nll_sum / jnp.maximum(tok_sum, 1.0)
+    return nll_sum / jnp.maximum(tok_sum, 1.0) + cfg.moe_aux_coeff * aux
 
 
 class Model:
@@ -407,6 +424,11 @@ class Model:
     def __init__(self, cfg: TransformerConfig, loss_fn: Optional[Callable] = None):
         self.config = cfg
         self._loss = loss_fn or causal_lm_loss
+        self.mesh = None  # set by the engine for MoE sharding constraints
+
+    def set_mesh(self, mesh):
+        self.mesh = mesh
+        _ACTIVE_MESH[0] = mesh
 
     def init(self, rng):
         return init(self.config, rng)

@@ -1,0 +1,46 @@
+"""MoE layer glue (reference: moe/layer.py:15 ``MoE`` wraps gate + experts +
+MOELayer). Used by models/transformer.py when ``moe_every > 0``."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .experts import apply_experts, experts_logical_axes, init_experts
+from .sharded_moe import moe_dispatch_combine
+
+
+def init_moe_params(rng, num_moe_layers: int, num_experts: int, d_model: int, d_ff: int):
+    """Stacked MoE params with leading [n_moe_layers] dim."""
+    keys = jax.random.split(rng, num_moe_layers + 1)
+    gates = jnp.stack(
+        [jax.random.normal(k, (d_model, num_experts)) * (1.0 / math.sqrt(d_model)) for k in keys[:num_moe_layers]]
+    )
+    banks = [init_experts(jax.random.fold_in(keys[-1], i), num_experts, d_model, d_ff) for i in range(num_moe_layers)]
+    all_experts = jax.tree.map(lambda *xs: jnp.stack(xs), *banks)
+    return {"gate": gates, "experts": all_experts}
+
+
+def moe_logical_axes():
+    ex = experts_logical_axes()
+    return {
+        "gate": (None, "embed", None),
+        "experts": {k: (None,) + v for k, v in ex.items()},
+    }
+
+
+def moe_ffn_apply(cfg, moe_params, h: jnp.ndarray, mesh=None):
+    """h [B, S, M] -> (out [B, S, M], aux_loss). One transformer MoE-FFN."""
+    B, S, M = h.shape
+    x = h.reshape(B * S, M)
+    out, aux = moe_dispatch_combine(
+        x,
+        moe_params["gate"],
+        lambda ei: apply_experts(moe_params["experts"], ei),
+        capacity_factor=cfg.moe_capacity_factor,
+        top_k=cfg.moe_top_k,
+        mesh=mesh,
+    )
+    return out.reshape(B, S, M), aux

@@ -1,0 +1,148 @@
+"""GShard-style top-k gating + dispatch/combine — TPU-native MoE core.
+
+The reference implements MoE as an eager pipeline (moe/sharded_moe.py:439
+MOELayer): gate → einsum dispatch → explicit ``_AllToAll`` autograd op over the
+EP process group (:89) → local experts → all-to-all back → combine. Here the
+same dataflow is expressed as pure einsum algebra with sharding constraints:
+the expert dimension is sharded over the ('data','fsdp') mesh axes (expert
+parallelism is a subset of data parallelism, reference utils/groups.py:109),
+and XLA inserts the all-to-alls where the sharded dim moves — no hand-written
+collective, and the gating math stays fully fused into the compiled step.
+
+Gating math follows reference moe/sharded_moe.py:177 (top1gating) and :278
+(top2gating): softmax gate, capacity = ceil(tokens/experts * cf), GShard
+load-balancing aux loss = E * mean(me · ce), position-in-expert via cumsum,
+over-capacity tokens dropped.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+EXPERT_AXES = ("data", "fsdp")  # EP rides the DP devices
+
+
+def _cumsum_exclusive(x, axis):
+    return jnp.cumsum(x, axis=axis) - x
+
+
+def top1_gating(logits: jnp.ndarray, capacity: int, rng: Optional[jax.Array] = None, noisy: bool = False):
+    """logits [T, E] -> (combine [T, E, C], dispatch bool [T, E, C], aux_loss).
+
+    reference: top1gating moe/sharded_moe.py:177.
+    """
+    T, E = logits.shape
+    if noisy and rng is not None:
+        logits_for_choice = logits + jax.random.gumbel(rng, logits.shape) * 1.0
+    else:
+        logits_for_choice = logits
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+    expert_idx = jnp.argmax(logits_for_choice, axis=-1)  # [T]
+    mask1 = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, E]
+
+    # GShard aux loss: E * mean_e(fraction routed to e * mean gate prob of e)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux_loss = jnp.sum(me * ce) * E
+
+    # position of each token within its expert's queue; drop past capacity
+    pos_in_expert = jnp.sum(_cumsum_exclusive(mask1, axis=0) * mask1, axis=-1)  # [T]
+    keep = pos_in_expert < capacity
+    mask1 = mask1 * keep[:, None]
+
+    gate1 = jnp.sum(gates * mask1, axis=-1)  # [T]
+    pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32)  # [T, C]
+    dispatch = mask1[:, :, None] * pos_oh[:, None, :]  # [T, E, C]
+    combine = gate1[:, None, None] * dispatch
+    return combine, dispatch.astype(bool), aux_loss
+
+
+def top2_gating(logits: jnp.ndarray, capacity: int, rng: Optional[jax.Array] = None):
+    """logits [T, E] -> (combine [T, E, C], dispatch [T, E, C], aux_loss).
+
+    reference: top2gating moe/sharded_moe.py:278 — second expert chosen after
+    masking the first; gates renormalized over the chosen pair.
+    """
+    T, E = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
+    gates_wo1 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates_wo1, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux_loss = jnp.sum(me * ce) * E
+
+    pos1 = jnp.sum(_cumsum_exclusive(mask1, axis=0) * mask1, axis=-1)
+    # expert-2 queue positions start after all expert-1 claims on that expert
+    count1 = jnp.sum(mask1, axis=0)  # [E]
+    pos2 = jnp.sum(_cumsum_exclusive(mask2, axis=0) * mask2, axis=-1) + jnp.sum(count1 * mask2, axis=-1)
+
+    mask1 = mask1 * (pos1 < capacity)[:, None]
+    mask2 = mask2 * (pos2 < capacity)[:, None]
+
+    gate1 = jnp.sum(gates * mask1, axis=-1)
+    gate2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.maximum(gate1 + gate2, jnp.finfo(jnp.float32).eps)
+    gate1, gate2 = gate1 / denom, gate2 / denom
+
+    pos1_oh = jax.nn.one_hot(pos1.astype(jnp.int32), capacity, dtype=jnp.float32)
+    pos2_oh = jax.nn.one_hot(pos2.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch1 = mask1[:, :, None] * pos1_oh[:, None, :]
+    dispatch2 = mask2[:, :, None] * pos2_oh[:, None, :]
+    combine = gate1[:, None, None] * dispatch1 + gate2[:, None, None] * dispatch2
+    dispatch = (dispatch1 + dispatch2) > 0
+    return combine, dispatch, aux_loss
+
+
+def compute_capacity(tokens: int, num_experts: int, capacity_factor: float, min_capacity: int = 4) -> int:
+    cap = int(tokens * capacity_factor / num_experts)
+    return max(cap, min_capacity)
+
+
+def moe_dispatch_combine(
+    x: jnp.ndarray,  # [T, M] token embeddings
+    gate_w: jnp.ndarray,  # [M, E]
+    expert_fn,  # [E, C, M] -> [E, C, M]
+    capacity_factor: float = 1.25,
+    top_k: int = 1,
+    mesh=None,
+    rng: Optional[jax.Array] = None,
+):
+    """Full MoE: gate → dispatch einsum → (implicit all_to_all) → experts →
+    (implicit all_to_all) → combine. Returns (out [T, M], aux_loss).
+
+    The reference's explicit ``_AllToAll.apply`` pair (moe/sharded_moe.py:456-472)
+    corresponds to the sharding constraints on ``expert_inputs`` /
+    ``expert_outputs`` here: [E, C, M] is sharded on E over the EP axes while
+    [T, E, C] tensors are sharded on T, so XLA lowers the einsum boundary to
+    an all-to-all over ICI.
+    """
+    T, M = x.shape
+    E = gate_w.shape[1]
+    C = compute_capacity(T * top_k, E, capacity_factor)
+
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # [T, E]
+    if top_k == 1:
+        combine, dispatch, aux = top1_gating(logits, C, rng)
+    else:
+        combine, dispatch, aux = top2_gating(logits, C, rng)
+
+    expert_inputs = jnp.einsum("tec,tm->ecm", dispatch.astype(x.dtype), x)  # [E, C, M]
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        ep_axes = tuple(a for a in EXPERT_AXES if mesh.shape.get(a, 1) > 1)
+        if ep_axes:
+            expert_inputs = jax.lax.with_sharding_constraint(
+                expert_inputs, NamedSharding(mesh, PartitionSpec(ep_axes, None, None))
+            )
+    expert_outputs = expert_fn(expert_inputs)  # [E, C, M]
+    out = jnp.einsum("tec,ecm->tm", combine.astype(x.dtype), expert_outputs)
+    return out, aux

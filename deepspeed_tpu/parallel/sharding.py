@@ -39,7 +39,9 @@ DEFAULT_TP_RULES: Rules = (
     ("ffn_in", "model"),
     ("embed", None),
     ("layers", None),
-    ("expert", "expert"),
+    # EP rides the DP devices (reference: utils/groups.py:109 "expert parallel
+    # group is a subset of data parallel group").
+    ("expert", ("data", "fsdp")),
     ("context", "context"),
     ("batch", ("data", "fsdp")),
 )

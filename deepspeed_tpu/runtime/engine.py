@@ -95,6 +95,8 @@ class DeepSpeedEngine:
             else DeepSpeedConfig.from_dict(raw, world_size=dp_world)
         )
         self.model = model
+        if hasattr(model, "set_mesh"):
+            model.set_mesh(self.mesh)
         self.dp_world = dp_world
         self.micro_batch_size = self.config.train_micro_batch_size_per_gpu
         self.gradient_accumulation_steps = self.config.gradient_accumulation_steps

@@ -1,0 +1,79 @@
+"""Ring attention (context parallelism) numerics vs plain causal attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
+from deepspeed_tpu.models.transformer import xla_attention
+from deepspeed_tpu.parallel.ring_attention import ring_attention_sharded
+
+
+@pytest.fixture
+def ctx_mesh():
+    return build_mesh(MeshConfig(data=2, context=4))
+
+
+def test_ring_matches_dense(ctx_mesh):
+    B, S, H, Dh = 4, 32, 2, 8
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, S, H, Dh))
+    k = jax.random.normal(kk, (B, S, H, Dh))
+    v = jax.random.normal(kv, (B, S, H, Dh))
+
+    expected = xla_attention(q, k, v)
+    got = ring_attention_sharded(q, k, v, mesh=ctx_mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_is_causal(ctx_mesh):
+    """Changing future tokens must not affect earlier outputs."""
+    B, S, H, Dh = 2, 32, 2, 8
+    rng = jax.random.PRNGKey(1)
+    q = jax.random.normal(rng, (B, S, H, Dh))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (B, S, H, Dh))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (B, S, H, Dh))
+    out1 = ring_attention_sharded(q, k, v, mesh=ctx_mesh)
+    k2 = k.at[:, -8:].set(99.0)
+    v2 = v.at[:, -8:].set(-99.0)
+    out2 = ring_attention_sharded(q, k2, v2, mesh=ctx_mesh)
+    np.testing.assert_allclose(np.asarray(out1[:, : S - 8]), np.asarray(out2[:, : S - 8]), rtol=1e-5, atol=1e-5)
+    assert not np.allclose(np.asarray(out1[:, -1]), np.asarray(out2[:, -1]))
+
+
+def test_ring_grad_flows(ctx_mesh):
+    B, S, H, Dh = 2, 16, 2, 4
+    rng = jax.random.PRNGKey(2)
+    q = jax.random.normal(rng, (B, S, H, Dh))
+    k = jax.random.normal(jax.random.fold_in(rng, 1), (B, S, H, Dh))
+    v = jax.random.normal(jax.random.fold_in(rng, 2), (B, S, H, Dh))
+
+    def f_ring(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh=ctx_mesh) ** 2)
+
+    def f_dense(q, k, v):
+        return jnp.sum(xla_attention(q, k, v) ** 2)
+
+    g_ring = jax.grad(f_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_in_model_training(ctx_mesh):
+    """End-to-end: transformer with attn_impl='ring' trains on a context mesh."""
+    import deepspeed_tpu
+    from simple_model import base_config, random_tokens, tiny_transformer
+
+    model = tiny_transformer(attn_impl="ring")
+    cfg = base_config(train_batch_size=8, train_micro_batch_size_per_gpu=2, gradient_accumulation_steps=2)
+    cfg["zero_optimization"] = {"stage": 0}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, mesh=ctx_mesh)
+    # seq must divide the context axis: pass explicit labels so S stays 32
+    toks = random_tokens(8, seq=32)["tokens"]
+    labels = np.concatenate([toks[:, 1:], np.full((8, 1), -1, np.int32)], axis=1)
+    batch = {"tokens": toks, "labels": labels}
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(3)]
+    assert losses[-1] < losses[0]
