@@ -283,6 +283,26 @@ def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, position
     return x, None
 
 
+def embed(cfg: TransformerConfig, params: Params, tokens, positions=None):
+    """Token (+ learned position) embedding -> (x [B,S,d], positions [B,S])."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    x = params["wte"][tokens].astype(cfg.dtype)
+    if cfg.pos_emb == "learned":
+        x = x + params["wpe"][positions].astype(cfg.dtype)
+    return x, positions
+
+
+def attn_bias(cfg: TransformerConfig, S: int):
+    """Additive attention bias [1,H,S,S] (alibi) or None."""
+    if cfg.pos_emb != "alibi":
+        return None
+    slopes = alibi_slopes(cfg.num_heads)
+    dist = jnp.arange(S)[None, :] - jnp.arange(S)[:, None]
+    return (slopes[:, None, None] * dist[None]).astype(jnp.float32)[None]
+
+
 def apply(
     cfg: TransformerConfig,
     params: Params,
@@ -295,19 +315,8 @@ def apply(
     states [B, S, d] when ``return_hidden`` (used by the chunked LM loss).
     With ``with_aux`` returns (out, aux_loss) — MoE load-balancing loss."""
     B, S = tokens.shape
-    dtype = cfg.dtype
-    if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
-    x = params["wte"][tokens].astype(dtype)
-    if cfg.pos_emb == "learned":
-        x = x + params["wpe"][positions].astype(dtype)
-
-    bias = None
-    if cfg.pos_emb == "alibi":
-        slopes = alibi_slopes(cfg.num_heads)
-        dist = jnp.arange(S)[None, :] - jnp.arange(S)[:, None]
-        bias = (slopes[:, None, None] * dist[None]).astype(jnp.float32)[None]  # [1,H,S,S]
-
+    x, positions = embed(cfg, params, tokens, positions)
+    bias = attn_bias(cfg, S)
     attn_fn = _attention_dispatch(cfg)
     body = partial(_layer_body, cfg, attn_fn, alibi_bias=bias, positions=positions)
 
@@ -369,36 +378,24 @@ def _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions):
 # Loss
 # ---------------------------------------------------------------------------
 
-def causal_lm_loss(cfg: TransformerConfig, params: Params, batch: dict) -> jnp.ndarray:
-    """Next-token cross-entropy. batch: {'tokens': [B,S]} or
-    {'input_ids': ..., 'labels': ...} (HF spelling accepted).
-
-    The vocab projection is chunked over the sequence (``loss_chunk_size``)
-    so the [B, S, vocab] logits tensor is never materialized — on a 16 GB
-    v5e this is what lets 125M-class models train at batch 64+.
-    """
-    tokens = batch.get("tokens", batch.get("input_ids"))
-    labels = batch.get("labels")
-    if labels is None:
-        inputs, labels = tokens[:, :-1], tokens[:, 1:]
-    else:
-        inputs = tokens
-
+def lm_loss_from_hidden(cfg: TransformerConfig, params: Params, hidden, labels) -> jnp.ndarray:
+    """Token-mean next-token cross-entropy from final hidden states [B,S,d],
+    with the vocab projection chunked over the sequence so [B,S,V] logits are
+    never materialized (see ``causal_lm_loss``). Shared by the plain and
+    pipelined model families."""
     head = params.get("lm_head", None)
     if head is None:
         head = params["wte"].T
 
     chunk = cfg.loss_chunk_size
-    S = inputs.shape[1]
+    S = hidden.shape[1]
     if chunk <= 0 or S % chunk != 0 or S <= chunk:
-        logits, aux = apply(cfg, params, inputs, with_aux=True)
+        logits = jnp.einsum("bsd,dv->bsv", hidden, head.astype(hidden.dtype)).astype(jnp.float32)
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
         mask = (labels >= 0).astype(jnp.float32)
-        nll = (logz - gold) * mask
-        return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1.0) + cfg.moe_aux_coeff * aux
+        return jnp.sum((logz - gold) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
-    hidden, aux = apply(cfg, params, inputs, return_hidden=True, with_aux=True)  # [B, S, d]
     n_chunks = S // chunk
     h_c = hidden.reshape(hidden.shape[0], n_chunks, chunk, hidden.shape[-1]).swapaxes(0, 1)
     l_c = labels.reshape(labels.shape[0], n_chunks, chunk).swapaxes(0, 1)
@@ -414,7 +411,29 @@ def causal_lm_loss(cfg: TransformerConfig, params: Params, batch: dict) -> jnp.n
         return (nll_sum + jnp.sum((logz - gold) * mask), tok_sum + jnp.sum(mask)), None
 
     (nll_sum, tok_sum), _ = lax.scan(chunk_loss, (jnp.zeros(()), jnp.zeros(())), (h_c, l_c))
-    return nll_sum / jnp.maximum(tok_sum, 1.0) + cfg.moe_aux_coeff * aux
+    return nll_sum / jnp.maximum(tok_sum, 1.0)
+
+
+def split_batch(batch: dict) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Normalize {'tokens'} / {'input_ids','labels'} batches to (inputs, labels)."""
+    tokens = batch.get("tokens", batch.get("input_ids"))
+    labels = batch.get("labels")
+    if labels is None:
+        return tokens[:, :-1], tokens[:, 1:]
+    return tokens, labels
+
+
+def causal_lm_loss(cfg: TransformerConfig, params: Params, batch: dict) -> jnp.ndarray:
+    """Next-token cross-entropy. batch: {'tokens': [B,S]} or
+    {'input_ids': ..., 'labels': ...} (HF spelling accepted).
+
+    The vocab projection is chunked over the sequence (``loss_chunk_size``)
+    so the [B, S, vocab] logits tensor is never materialized — on a 16 GB
+    v5e this is what lets 125M-class models train at batch 64+.
+    """
+    inputs, labels = split_batch(batch)
+    hidden, aux = apply(cfg, params, inputs, return_hidden=True, with_aux=True)  # [B, S, d]
+    return lm_loss_from_hidden(cfg, params, hidden, labels) + cfg.moe_aux_coeff * aux
 
 
 class Model:

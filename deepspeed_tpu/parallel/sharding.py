@@ -32,6 +32,7 @@ Rules = Sequence[tuple[str, MeshAxes]]
 # Default logical-axis → mesh-axis table for transformer models.
 # 'model' = tensor parallel; 'fsdp' = ZeRO-3 axis; None = replicated.
 DEFAULT_TP_RULES: Rules = (
+    ("stage", "pipe"),
     ("vocab", "model"),
     ("heads", "model"),
     ("kv", None),

@@ -1,0 +1,18 @@
+"""Pipeline parallelism (reference: deepspeed/runtime/pipe/)."""
+
+from .engine import PipelineEngine, pipeline_apply
+from .module import (
+    LayerSpec,
+    PipelinedTransformer,
+    PipelineModule,
+    TiedLayerSpec,
+    partition_balanced,
+    partition_uniform,
+)
+from .schedule import InferenceSchedule, PipeSchedule, TrainSchedule
+from .topology import (
+    PipeDataParallelTopology,
+    PipelineParallelGrid,
+    PipeModelDataParallelTopology,
+    ProcessTopology,
+)
