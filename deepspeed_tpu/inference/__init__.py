@@ -1,0 +1,3 @@
+"""Inference stack (reference: deepspeed/inference/)."""
+
+from .engine import InferenceEngine

@@ -50,6 +50,8 @@ class TransformerConfig:
     layernorm_epsilon: float = 1e-5
     tie_embeddings: bool = True
     use_bias: bool = True
+    activation: str = "gelu"  # gelu | gelu_exact | relu
+    embed_ln: bool = False  # LayerNorm after embedding (BLOOM)
     attn_impl: str = "xla"  # xla | flash | ring
     remat: bool = False  # activation checkpointing over the layer scan
     remat_policy: str = "nothing_saveable"
@@ -121,6 +123,9 @@ def init(cfg: TransformerConfig, rng: jax.Array) -> Params:
     }
     if cfg.pos_emb == "learned":
         params["wpe"] = jax.random.normal(keys[7], (cfg.max_seq_len, d)) * 0.01
+    if cfg.embed_ln:
+        params["emb_ln_scale"] = jnp.ones((d,))
+        params["emb_ln_bias"] = jnp.zeros((d,))
     if not cfg.tie_embeddings:
         params["lm_head"] = _dense_init(keys[8], (d, cfg.vocab_size), d)
     if cfg.moe_every > 0:
@@ -165,6 +170,9 @@ def logical_axes(cfg: TransformerConfig) -> Params:
     }
     if cfg.pos_emb == "learned":
         axes["wpe"] = (None, "embed")
+    if cfg.embed_ln:
+        axes["emb_ln_scale"] = ("embed",)
+        axes["emb_ln_bias"] = ("embed",)
     if not cfg.tie_embeddings:
         axes["lm_head"] = ("embed", "vocab")
     if cfg.moe_every > 0:
@@ -235,7 +243,12 @@ def _attention_dispatch(cfg: TransformerConfig):
     if cfg.attn_impl == "flash":
         from ..ops.pallas.flash_attention import flash_attention
 
-        return lambda q, k, v, bias: flash_attention(q, k, v, causal=True, bias=bias)
+        # additive bias (alibi) is not fused — those layers take the XLA path
+        return lambda q, k, v, bias: (
+            flash_attention(q, k, v, causal=True)
+            if bias is None
+            else xla_attention(q, k, v, bias=bias)
+        )
     if cfg.attn_impl == "ring":
         from ..parallel.ring_attention import ring_attention_sharded
 
@@ -247,16 +260,20 @@ def _ffn(cfg, lp, h):
     u = jnp.einsum("bsd,df->bsf", h, lp["wi"].astype(h.dtype))
     if cfg.use_bias:
         u = u + lp["bi"].astype(h.dtype)
-    u = jax.nn.gelu(u, approximate=True)
+    if cfg.activation == "relu":
+        u = jax.nn.relu(u)
+    elif cfg.activation == "gelu_exact":
+        u = jax.nn.gelu(u, approximate=False)
+    else:
+        u = jax.nn.gelu(u, approximate=True)
     out = jnp.einsum("bsf,fd->bsd", u, lp["wo_mlp"].astype(h.dtype))
     if cfg.use_bias:
         out = out + lp["bo_mlp"].astype(h.dtype)
     return out
 
 
-def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, positions):
-    x = carry  # [B, S, d] compute dtype
-    h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
+def _qkv_proj(cfg: TransformerConfig, lp, h, positions):
+    """LN'd hidden states -> rotary-embedded q, k, v [B, T, H, Dh]."""
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(h.dtype))
     k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(h.dtype))
     v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(h.dtype))
@@ -268,10 +285,21 @@ def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, position
         rd = int(cfg.head_dim * cfg.rotary_pct)
         q = rotary_embed(q, positions, rd)
         k = rotary_embed(k, positions, rd)
-    attn_out = attn_fn(q, k, v, alibi_bias)
-    attn_out = jnp.einsum("bshk,hkd->bsd", attn_out, lp["wo"].astype(h.dtype))
+    return q, k, v
+
+
+def _attn_out_proj(cfg: TransformerConfig, lp, attn_out):
+    out = jnp.einsum("bshk,hkd->bsd", attn_out, lp["wo"].astype(attn_out.dtype))
     if cfg.use_bias:
-        attn_out = attn_out + lp["bo"].astype(h.dtype)
+        out = out + lp["bo"].astype(attn_out.dtype)
+    return out
+
+
+def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, positions):
+    x = carry  # [B, S, d] compute dtype
+    h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
+    q, k, v = _qkv_proj(cfg, lp, h, positions)
+    attn_out = _attn_out_proj(cfg, lp, attn_fn(q, k, v, alibi_bias))
 
     if cfg.parallel_residual:
         h2 = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_epsilon)
@@ -291,6 +319,8 @@ def embed(cfg: TransformerConfig, params: Params, tokens, positions=None):
     x = params["wte"][tokens].astype(cfg.dtype)
     if cfg.pos_emb == "learned":
         x = x + params["wpe"][positions].astype(cfg.dtype)
+    if cfg.embed_ln:
+        x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"], cfg.layernorm_epsilon)
     return x, positions
 
 
@@ -357,21 +387,87 @@ def _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions):
     from ..moe.layer import moe_ffn_apply
 
     h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
-    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(h.dtype))
-    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(h.dtype))
-    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(h.dtype))
-    if cfg.use_bias:
-        q, k, v = q + lp["bq"].astype(h.dtype), k + lp["bk"].astype(h.dtype), v + lp["bv"].astype(h.dtype)
-    if cfg.pos_emb == "rotary":
-        rd = int(cfg.head_dim * cfg.rotary_pct)
-        q, k = rotary_embed(q, positions, rd), rotary_embed(k, positions, rd)
-    attn_out = jnp.einsum("bshk,hkd->bsd", attn_fn(q, k, v, bias), lp["wo"].astype(h.dtype))
-    if cfg.use_bias:
-        attn_out = attn_out + lp["bo"].astype(h.dtype)
-    x = x + attn_out
+    q, k, v = _qkv_proj(cfg, lp, h, positions)
+    x = x + _attn_out_proj(cfg, lp, attn_fn(q, k, v, bias))
     h2 = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_epsilon)
     moe_out, aux_loss = moe_ffn_apply(cfg, moe_p, h2, mesh=_ACTIVE_MESH[0])
     return x + moe_out, aux_loss
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decoding (generative inference)
+# ---------------------------------------------------------------------------
+#
+# The reference's decode path is the fused `softmax_context` CUDA kernel with
+# an incremental KV cache (csrc/transformer/inference/csrc/pt_binding.cpp:
+# softmax_context_* :1237, attention-with-cache). TPU-native: the cache is a
+# static-shape [L, B, Smax, H, Dh] pair threaded through the layer scan; one
+# `apply_with_cache` function serves both prefill (T = prompt len, pos = 0)
+# and decode (T = 1) so XLA compiles exactly two programs per sequence budget.
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int, dtype=None):
+    """Allocate an empty KV cache for ``batch`` sequences of up to ``max_len``."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, batch, max_len, cfg.num_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cached_attention(q, k_cache, v_cache, pos, *, bias=None):
+    """Attention of q [B,T,H,Dh] against a [B,Smax,H,Dh] cache whose valid
+    keys are [0, pos+T): the causal mask with offset ``pos`` covers the
+    prefix, the new block's internal causality, and the padding tail."""
+    return xla_attention(q, k_cache, v_cache, causal_offset=pos, bias=bias)
+
+
+def apply_with_cache(
+    cfg: TransformerConfig, params: Params, tokens, cache, pos, last_only: bool = False
+):
+    """tokens [B, T] entering at absolute position ``pos`` -> (logits, updated
+    cache). Serves prefill (T=prompt) and decode (T=1). With ``last_only``
+    only the final position is projected to the vocab (prefill never
+    materializes [B, S, V] — same motivation as the chunked LM loss)."""
+    if cfg.moe_every > 0:
+        raise NotImplementedError(
+            "apply_with_cache does not route MoE layers yet; moe_every must be 0"
+        )
+    B, T = tokens.shape
+    positions = pos + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    x, _ = embed(cfg, params, tokens, positions)
+
+    bias = None
+    if cfg.pos_emb == "alibi":
+        # alibi distances vs absolute key positions, rows = new tokens
+        slopes = alibi_slopes(cfg.num_heads)
+        Smax = cache["k"].shape[2]
+        dist = jnp.arange(Smax)[None, :] - (pos + jnp.arange(T)[:, None])
+        bias = (slopes[:, None, None] * dist[None]).astype(jnp.float32)[None]
+
+    def layer(carry, inputs):
+        x = carry
+        lp, k_cache, v_cache = inputs
+        h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
+        q, k, v = _qkv_proj(cfg, lp, h, positions)
+        k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+        attn_out = _attn_out_proj(cfg, lp, cached_attention(q, k_cache, v_cache, pos, bias=bias))
+        if cfg.parallel_residual:
+            h2 = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_epsilon)
+            x = x + attn_out + _ffn(cfg, lp, h2)
+        else:
+            x = x + attn_out
+            h2 = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_epsilon)
+            x = x + _ffn(cfg, lp, h2)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
+    if last_only:
+        x = x[:, -1:]
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["wte"].T
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype)).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v}
 
 
 # ---------------------------------------------------------------------------

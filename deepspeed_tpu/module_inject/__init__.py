@@ -1,0 +1,24 @@
+"""Model injection — HF checkpoint → TPU-native compiled model.
+
+Reference: ``deepspeed/module_inject/`` — ``replace_transformer_layer``
+(replace_module.py:137) swaps HF/Megatron layers for fused CUDA modules and
+TP-sliced linears, driven by per-architecture ``DSPolicy`` weight-name maps
+(replace_policy.py).
+
+TPU-native inversion: instead of mutating a live torch module tree, a policy
+CONVERTS the source checkpoint's weights into the params pytree of the
+framework's compiled transformer family (models/transformer.py), and
+tensor-parallel "slicing" is a sharding spec applied when the params are
+device_put onto the mesh — XLA partitions the matmuls the reference slices by
+hand (module_inject/layers.py LinearLayer/LinearAllreduce).
+"""
+
+from .replace_policy import (
+    BloomLayerPolicy,
+    DSPolicy,
+    GPTNeoXLayerPolicy,
+    HFGPT2LayerPolicy,
+    HFOPTLayerPolicy,
+    policy_for,
+    replace_module,
+)

@@ -1,0 +1,439 @@
+"""Per-architecture injection policies.
+
+Reference: ``deepspeed/module_inject/replace_policy.py`` — ``DSPolicy`` (:12)
+and the HF architecture policies (HFGPT2LayerPolicy :299, HFOPTLayerPolicy
+:435, BLOOMLayerPolicy :339, GPTNEOXLayerPolicy :381, MegatronLayerPolicy
+:219). Each reference policy answers "where do q/k/v/o and the MLP weights
+live in this architecture, and how is qkv fused" so the engine can rebuild
+the layer with fused kernels + TP slicing.
+
+Here a policy answers the same questions but emits the params pytree of the
+compiled transformer family (models/transformer.py) directly. The two fused
+qkv conventions handled:
+
+  * GPT2-style  [d, 3d]: q|k|v concatenated blockwise (Conv1D, [in, out])
+  * NeoX/BLOOM  [3d, d]: per-head interleave — output rows grouped as
+    (head, {q,k,v}, head_dim) (torch Linear, [out, in])
+
+Not covered this round: GPT-J (interleaved even/odd rotary) and GPT-Neo
+(alternating local attention) — they need model-family variants, not just
+weight maps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..models.transformer import Model, TransformerConfig
+
+
+def _map_activation(name: str) -> str:
+    """HF activation name -> TransformerConfig.activation. HF's plain "gelu"
+    is the exact erf form; "gelu_new"/"gelu_fast"/"gelu_pytorch_tanh" are the
+    tanh approximation."""
+    name = (name or "gelu_new").lower()
+    if name == "relu":
+        return "relu"
+    if name == "gelu":
+        return "gelu_exact"
+    if name in ("gelu_new", "gelu_fast", "gelu_pytorch_tanh", "gelu_python"):
+        return "gelu"
+    raise ValueError(f"unsupported activation {name!r}")
+
+
+def _t2np(t) -> np.ndarray:
+    """torch tensor / array-like -> float32 numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _stack(layers: list[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:
+    return {k: np.stack([l[k] for l in layers]) for k in layers[0]}
+
+
+class DSPolicy:
+    """Base policy (reference replace_policy.py:12)."""
+
+    model_type: str = ""
+
+    @classmethod
+    def match(cls, hf_config) -> bool:
+        return getattr(hf_config, "model_type", None) == cls.model_type
+
+    def build_config(self, hf, dtype) -> TransformerConfig:
+        raise NotImplementedError
+
+    def convert(self, hf, sd: dict[str, Any], dtype) -> tuple[TransformerConfig, dict]:
+        raise NotImplementedError
+
+    # -- shared helpers -----------------------------------------------------
+    @staticmethod
+    def split_qkv_blockwise(w, b, H, Dh):
+        """[d, 3d] (+[3d] bias) -> per-projection [d,H,Dh] / [H,Dh]."""
+        d = w.shape[0]
+        q, k, v = np.split(w, 3, axis=1)
+        out = {
+            "wq": q.reshape(d, H, Dh),
+            "wk": k.reshape(d, H, Dh),
+            "wv": v.reshape(d, H, Dh),
+        }
+        if b is not None:
+            bq, bk, bv = np.split(b, 3)
+            out.update(bq=bq.reshape(H, Dh), bk=bk.reshape(H, Dh), bv=bv.reshape(H, Dh))
+        return out
+
+    @staticmethod
+    def split_qkv_per_head(w, b, H, Dh):
+        """NeoX/BLOOM fused [3d, d] with rows grouped (H, {q,k,v}, Dh)."""
+        d = w.shape[1]
+        w = w.reshape(H, 3, Dh, d)
+        out = {
+            "wq": w[:, 0].transpose(2, 0, 1),  # [d, H, Dh]
+            "wk": w[:, 1].transpose(2, 0, 1),
+            "wv": w[:, 2].transpose(2, 0, 1),
+        }
+        if b is not None:
+            b = b.reshape(H, 3, Dh)
+            out.update(bq=b[:, 0], bk=b[:, 1], bv=b[:, 2])
+        return out
+
+
+class HFGPT2LayerPolicy(DSPolicy):
+    """GPT2LMHeadModel (reference replace_policy.py:299). Conv1D stores
+    weights [in, out], so no transposes are needed."""
+
+    model_type = "gpt2"
+
+    def build_config(self, hf, dtype) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf.vocab_size,
+            max_seq_len=hf.n_positions,
+            num_layers=hf.n_layer,
+            num_heads=hf.n_head,
+            hidden_size=hf.n_embd,
+            intermediate_size=hf.n_inner or 4 * hf.n_embd,
+            pos_emb="learned",
+            activation=_map_activation(getattr(hf, "activation_function", "gelu_new")),
+            layernorm_epsilon=hf.layer_norm_epsilon,
+            tie_embeddings=True,
+            dtype=dtype,
+        )
+
+    def convert(self, hf, sd, dtype):
+        cfg = self.build_config(hf, dtype)
+        H, Dh, d = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+        p = {k: _t2np(v) for k, v in sd.items()}
+        pre = "transformer." if any(k.startswith("transformer.") for k in p) else ""
+        layers = []
+        for i in range(cfg.num_layers):
+            b = f"{pre}h.{i}."
+            lp = {
+                "ln1_scale": p[b + "ln_1.weight"],
+                "ln1_bias": p[b + "ln_1.bias"],
+                "ln2_scale": p[b + "ln_2.weight"],
+                "ln2_bias": p[b + "ln_2.bias"],
+                "wo": p[b + "attn.c_proj.weight"].reshape(H, Dh, d),
+                "bo": p[b + "attn.c_proj.bias"],
+                "wi": p[b + "mlp.c_fc.weight"],
+                "bi": p[b + "mlp.c_fc.bias"],
+                "wo_mlp": p[b + "mlp.c_proj.weight"],
+                "bo_mlp": p[b + "mlp.c_proj.bias"],
+            }
+            lp.update(
+                self.split_qkv_blockwise(p[b + "attn.c_attn.weight"], p[b + "attn.c_attn.bias"], H, Dh)
+            )
+            layers.append(lp)
+        params = {
+            "wte": p[pre + "wte.weight"],
+            "wpe": p[pre + "wpe.weight"],
+            "layers": _stack(layers),
+            "lnf_scale": p[pre + "ln_f.weight"],
+            "lnf_bias": p[pre + "ln_f.bias"],
+        }
+        return cfg, params
+
+
+class HFOPTLayerPolicy(DSPolicy):
+    """OPTForCausalLM (reference replace_policy.py:435). torch Linear stores
+    [out, in] → transpose; learned positions are offset by 2 rows."""
+
+    model_type = "opt"
+
+    def build_config(self, hf, dtype) -> TransformerConfig:
+        assert getattr(hf, "do_layer_norm_before", True), "post-LN OPT variants unsupported"
+        return TransformerConfig(
+            vocab_size=hf.vocab_size,
+            max_seq_len=hf.max_position_embeddings,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.ffn_dim,
+            pos_emb="learned",
+            activation=_map_activation(getattr(hf, "activation_function", "relu")),
+            layernorm_epsilon=1e-5,
+            tie_embeddings=True,
+            dtype=dtype,
+        )
+
+    def convert(self, hf, sd, dtype):
+        cfg = self.build_config(hf, dtype)
+        H, Dh, d = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+        p = {k: _t2np(v) for k, v in sd.items()}
+        pre = "model." if any(k.startswith("model.") for k in p) else ""
+        dec = pre + "decoder."
+        layers = []
+        for i in range(cfg.num_layers):
+            b = f"{dec}layers.{i}."
+            lp = {
+                "ln1_scale": p[b + "self_attn_layer_norm.weight"],
+                "ln1_bias": p[b + "self_attn_layer_norm.bias"],
+                "ln2_scale": p[b + "final_layer_norm.weight"],
+                "ln2_bias": p[b + "final_layer_norm.bias"],
+                "wq": p[b + "self_attn.q_proj.weight"].T.reshape(d, H, Dh),
+                "wk": p[b + "self_attn.k_proj.weight"].T.reshape(d, H, Dh),
+                "wv": p[b + "self_attn.v_proj.weight"].T.reshape(d, H, Dh),
+                "bq": p[b + "self_attn.q_proj.bias"].reshape(H, Dh),
+                "bk": p[b + "self_attn.k_proj.bias"].reshape(H, Dh),
+                "bv": p[b + "self_attn.v_proj.bias"].reshape(H, Dh),
+                "wo": p[b + "self_attn.out_proj.weight"].T.reshape(H, Dh, d),
+                "bo": p[b + "self_attn.out_proj.bias"],
+                "wi": p[b + "fc1.weight"].T,
+                "bi": p[b + "fc1.bias"],
+                "wo_mlp": p[b + "fc2.weight"].T,
+                "bo_mlp": p[b + "fc2.bias"],
+            }
+            layers.append(lp)
+        params = {
+            "wte": p[dec + "embed_tokens.weight"],
+            # OPT's position table has 2 pad rows; positions are looked up at +2
+            "wpe": p[dec + "embed_positions.weight"][2:],
+            "layers": _stack(layers),
+            "lnf_scale": p[dec + "final_layer_norm.weight"],
+            "lnf_bias": p[dec + "final_layer_norm.bias"],
+        }
+        return cfg, params
+
+
+class GPTNeoXLayerPolicy(DSPolicy):
+    """GPTNeoXForCausalLM (reference replace_policy.py:381): rotary with
+    rotary_pct, parallel residual, untied lm head, per-head fused qkv."""
+
+    model_type = "gpt_neox"
+
+    def build_config(self, hf, dtype) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf.vocab_size,
+            max_seq_len=hf.max_position_embeddings,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            pos_emb="rotary",
+            rotary_pct=hf.rotary_pct,
+            activation=_map_activation(getattr(hf, "hidden_act", "gelu")),
+            parallel_residual=getattr(hf, "use_parallel_residual", True),
+            layernorm_epsilon=hf.layer_norm_eps,
+            tie_embeddings=False,
+            dtype=dtype,
+        )
+
+    def convert(self, hf, sd, dtype):
+        cfg = self.build_config(hf, dtype)
+        H, Dh, d = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+        p = {k: _t2np(v) for k, v in sd.items()}
+        g = "gpt_neox."
+        layers = []
+        for i in range(cfg.num_layers):
+            b = f"{g}layers.{i}."
+            lp = {
+                "ln1_scale": p[b + "input_layernorm.weight"],
+                "ln1_bias": p[b + "input_layernorm.bias"],
+                "ln2_scale": p[b + "post_attention_layernorm.weight"],
+                "ln2_bias": p[b + "post_attention_layernorm.bias"],
+                "wo": p[b + "attention.dense.weight"].T.reshape(H, Dh, d),
+                "bo": p[b + "attention.dense.bias"],
+                "wi": p[b + "mlp.dense_h_to_4h.weight"].T,
+                "bi": p[b + "mlp.dense_h_to_4h.bias"],
+                "wo_mlp": p[b + "mlp.dense_4h_to_h.weight"].T,
+                "bo_mlp": p[b + "mlp.dense_4h_to_h.bias"],
+            }
+            lp.update(
+                self.split_qkv_per_head(
+                    p[b + "attention.query_key_value.weight"],
+                    p[b + "attention.query_key_value.bias"],
+                    H,
+                    Dh,
+                )
+            )
+            layers.append(lp)
+        params = {
+            "wte": p[g + "embed_in.weight"],
+            "layers": _stack(layers),
+            "lnf_scale": p[g + "final_layer_norm.weight"],
+            "lnf_bias": p[g + "final_layer_norm.bias"],
+            "lm_head": p["embed_out.weight"].T,
+        }
+        return cfg, params
+
+
+class BloomLayerPolicy(DSPolicy):
+    """BloomForCausalLM (reference replace_policy.py:339): alibi positions,
+    embedding LayerNorm, per-head fused qkv."""
+
+    model_type = "bloom"
+
+    def build_config(self, hf, dtype) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf.vocab_size,
+            max_seq_len=getattr(hf, "seq_length", 2048),
+            num_layers=hf.n_layer,
+            num_heads=hf.n_head,
+            hidden_size=hf.hidden_size,
+            intermediate_size=4 * hf.hidden_size,
+            pos_emb="alibi",
+            embed_ln=True,
+            layernorm_epsilon=hf.layer_norm_epsilon,
+            tie_embeddings=True,
+            dtype=dtype,
+        )
+
+    def convert(self, hf, sd, dtype):
+        cfg = self.build_config(hf, dtype)
+        H, Dh, d = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+        p = {k: _t2np(v) for k, v in sd.items()}
+        pre = "transformer." if any(k.startswith("transformer.") for k in p) else ""
+        layers = []
+        for i in range(cfg.num_layers):
+            b = f"{pre}h.{i}."
+            lp = {
+                "ln1_scale": p[b + "input_layernorm.weight"],
+                "ln1_bias": p[b + "input_layernorm.bias"],
+                "ln2_scale": p[b + "post_attention_layernorm.weight"],
+                "ln2_bias": p[b + "post_attention_layernorm.bias"],
+                "wo": p[b + "self_attention.dense.weight"].T.reshape(H, Dh, d),
+                "bo": p[b + "self_attention.dense.bias"],
+                "wi": p[b + "mlp.dense_h_to_4h.weight"].T,
+                "bi": p[b + "mlp.dense_h_to_4h.bias"],
+                "wo_mlp": p[b + "mlp.dense_4h_to_h.weight"].T,
+                "bo_mlp": p[b + "mlp.dense_4h_to_h.bias"],
+            }
+            lp.update(
+                self.split_qkv_per_head(
+                    p[b + "self_attention.query_key_value.weight"],
+                    p[b + "self_attention.query_key_value.bias"],
+                    H,
+                    Dh,
+                )
+            )
+            layers.append(lp)
+        params = {
+            "wte": p[pre + "word_embeddings.weight"],
+            "emb_ln_scale": p[pre + "word_embeddings_layernorm.weight"],
+            "emb_ln_bias": p[pre + "word_embeddings_layernorm.bias"],
+            "layers": _stack(layers),
+            "lnf_scale": p[pre + "ln_f.weight"],
+            "lnf_bias": p[pre + "ln_f.bias"],
+        }
+        return cfg, params
+
+
+class MegatronLayerPolicy(DSPolicy):
+    """Megatron-LM GPT2 checkpoints (reference replace_policy.py:219):
+    same per-head fused qkv as NeoX, learned positions, tied head."""
+
+    model_type = "megatron"
+
+    @classmethod
+    def match(cls, hf_config) -> bool:
+        return getattr(hf_config, "model_type", None) in ("megatron", "megatron-gpt2")
+
+    def build_config(self, hf, dtype) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf.vocab_size,
+            max_seq_len=hf.max_position_embeddings,
+            num_layers=hf.num_layers,
+            num_heads=hf.num_attention_heads,
+            hidden_size=hf.hidden_size,
+            pos_emb="learned",
+            tie_embeddings=True,
+            dtype=dtype,
+        )
+
+    def convert(self, hf, sd, dtype):
+        cfg = self.build_config(hf, dtype)
+        H, Dh, d = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+        p = {k: _t2np(v) for k, v in sd.items()}
+        layers = []
+        for i in range(cfg.num_layers):
+            b = f"transformer.layers.{i}."
+            lp = {
+                "ln1_scale": p[b + "input_layernorm.weight"],
+                "ln1_bias": p[b + "input_layernorm.bias"],
+                "ln2_scale": p[b + "post_attention_layernorm.weight"],
+                "ln2_bias": p[b + "post_attention_layernorm.bias"],
+                "wo": p[b + "attention.dense.weight"].T.reshape(H, Dh, d),
+                "bo": p[b + "attention.dense.bias"],
+                "wi": p[b + "mlp.dense_h_to_4h.weight"].T,
+                "bi": p[b + "mlp.dense_h_to_4h.bias"],
+                "wo_mlp": p[b + "mlp.dense_4h_to_h.weight"].T,
+                "bo_mlp": p[b + "mlp.dense_4h_to_h.bias"],
+            }
+            lp.update(
+                self.split_qkv_per_head(
+                    p[b + "attention.query_key_value.weight"],
+                    p[b + "attention.query_key_value.bias"],
+                    H,
+                    Dh,
+                )
+            )
+            layers.append(lp)
+        params = {
+            "wte": p["word_embeddings.weight"],
+            "wpe": p["position_embeddings.weight"],
+            "layers": _stack(layers),
+            "lnf_scale": p["transformer.final_layernorm.weight"],
+            "lnf_bias": p["transformer.final_layernorm.bias"],
+        }
+        return cfg, params
+
+
+ALL_POLICIES = [
+    HFGPT2LayerPolicy,
+    HFOPTLayerPolicy,
+    GPTNeoXLayerPolicy,
+    BloomLayerPolicy,
+    MegatronLayerPolicy,
+]
+
+
+def policy_for(hf_config) -> DSPolicy:
+    for cls in ALL_POLICIES:
+        if cls.match(hf_config):
+            return cls()
+    raise ValueError(
+        f"no injection policy for model_type={getattr(hf_config, 'model_type', None)!r}; "
+        f"supported: {[c.model_type for c in ALL_POLICIES]}"
+    )
+
+
+def replace_module(hf_model=None, hf_config=None, state_dict=None, dtype=None):
+    """Convert an HF model (or config + state_dict) into (Model, params).
+
+    Reference analogue: ``replace_transformer_layer``
+    (module_inject/replace_module.py:137) + checkpoint loading — but instead
+    of swapping submodules in place, the whole network is rebuilt as the
+    compiled transformer family.
+    """
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    if hf_model is not None:
+        hf_config = hf_model.config
+        state_dict = hf_model.state_dict()
+    assert hf_config is not None and state_dict is not None
+    policy = policy_for(hf_config)
+    cfg, params = policy.convert(hf_config, state_dict, dtype)
+    return Model(cfg), params

@@ -1,0 +1,375 @@
+"""Fused attention Pallas kernels (training fwd + bwd).
+
+The reference's training-side attention lives in the fused CUDA transformer
+layer (csrc/transformer/ds_transformer_cuda.cpp: softmax_kernels.cu +
+strided-batch GEMMs in cublas_wrappers.cu, bound via `forward_fp16`/
+`backward_fp16` :1029-1047). The TPU-native equivalent is a blockwise
+online-softmax ("flash") attention pair of kernels:
+
+  * forward never materializes the [S, S] score matrix: per q-block it
+    streams k/v blocks, keeping a running row-max / row-sum (online softmax)
+    and a [Bq, D] accumulator in VMEM; saves the per-row logsumexp for the
+    backward pass.
+  * backward recomputes P = exp(QK^T·scale − L) blockwise (FlashAttention-2
+    decomposition): one kernel accumulates dK/dV over q-blocks, one
+    accumulates dQ over k-blocks; the softmax Jacobian term uses
+    D_i = rowsum(dO ∘ O) computed in plain XLA.
+
+Causal masking skips fully-masked blocks via dynamic loop bounds (the block
+analogue of the reference's triangular softmax kernels). On non-TPU backends
+the kernels run in Pallas interpreter mode so tests exercise the same code.
+
+Layout: public API takes [B, S, H, D] (the model family's layout) and maps
+over fused batch×head programs internally.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on non-TPU backends; kernels then run interpreted
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+LANES = 128  # TPU lane width; LSE/delta are stored lane-broadcast
+NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _vmem_spec(shape, index_map):
+    if _VMEM is not None:
+        return pl.BlockSpec(shape, index_map, memory_space=_VMEM)
+    return pl.BlockSpec(shape, index_map)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    seq_k = k_ref.shape[1]
+    num_k = seq_k // block_k
+
+    q = q_ref[0]  # [Bq, D] native dtype — MXU runs at full rate in bf16
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(kj, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :]
+        s = sm_scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Bq, Bk] fp32 accumulator
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    if causal:
+        # blocks at or before the diagonal: kj*Bk <= qi*Bq + Bq - 1
+        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, num_k)
+    else:
+        hi = num_k
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # LSE broadcast over a 128-lane trailing axis to satisfy TPU tiling
+    lse = m + jnp.log(l_safe)
+    lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, LANES))
+
+
+def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    grid = (BH, Sq // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _vmem_spec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            _vmem_spec((1, Sk, D), lambda bh, qi: (bh, 0, 0)),
+            _vmem_spec((1, Sk, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sq, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _widen(lane_tile, width):
+    """[rows, LANES] lane-broadcast tile -> [rows, width] (all lanes equal)."""
+    if width == LANES:
+        return lane_tile
+    if width % LANES == 0:
+        return jnp.tile(lane_tile, (1, width // LANES))
+    return lane_tile[:, :width]
+
+
+
+def _bwd_dkdv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, sm_scale, causal, block_q,
+):
+    kj = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    seq_q = q_ref.shape[1]
+    num_q = seq_q // block_q
+
+    k_blk = k_ref[0]  # [Bk, D]
+    v_blk = v_ref[0]
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]      # [Bq, LANES]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]  # [Bq, LANES]
+
+        s = sm_scale * jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Bq, Bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - _widen(lse, block_k))  # [Bq, Bk]
+        # dV += P^T dO
+        dv = dv + jax.lax.dot_general(
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dS = P ∘ (dO V^T − Δ)
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - _widen(delta, block_k))
+        # dK += dS^T Q · scale
+        dk = dk + sm_scale * jax.lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    if causal:
+        lo = jax.lax.div(kj * block_k, block_q)
+    else:
+        lo = 0
+    dk, dv = jax.lax.fori_loop(lo, num_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, sm_scale, causal, block_k,
+):
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    seq_k = k_ref.shape[1]
+    num_k = seq_k // block_k
+
+    q_blk = q_ref[0]
+    do_blk = do_ref[0]
+    lse = lse_ref[0]      # [Bq, LANES]
+    delta = delta_ref[0]  # [Bq, LANES]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(kj, dq):
+        k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :]
+        s = sm_scale * jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - _widen(lse, block_k))
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - _widen(delta, block_k))
+        return dq + sm_scale * jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, num_k)
+    else:
+        hi = num_k
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_backward(res, g, sm_scale, causal, block_q, block_k, interpret):
+    q, k, v, out, lse = res
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [BH,Sq]
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
+
+    dkdv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q
+        ),
+        grid=(BH, Sk // block_k),
+        in_specs=[
+            _vmem_spec((1, Sq, D), lambda bh, kj: (bh, 0, 0)),
+            _vmem_spec((1, block_k, D), lambda bh, kj: (bh, kj, 0)),
+            _vmem_spec((1, block_k, D), lambda bh, kj: (bh, kj, 0)),
+            _vmem_spec((1, Sq, D), lambda bh, kj: (bh, 0, 0)),
+            _vmem_spec((1, Sq, LANES), lambda bh, kj: (bh, 0, 0)),
+            _vmem_spec((1, Sq, LANES), lambda bh, kj: (bh, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_k, D), lambda bh, kj: (bh, kj, 0)),
+            _vmem_spec((1, block_k, D), lambda bh, kj: (bh, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    dk, dv = dkdv
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k
+        ),
+        grid=(BH, Sq // block_q),
+        in_specs=[
+            _vmem_spec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            _vmem_spec((1, Sk, D), lambda bh, qi: (bh, 0, 0)),
+            _vmem_spec((1, Sk, D), lambda bh, qi: (bh, 0, 0)),
+            _vmem_spec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_specs=_vmem_spec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_bhsd_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bhsd_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_backward(res, g, sm_scale, causal, block_q, block_k, interpret)
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    bias=None,
+    sm_scale: float | None = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool | None = None,
+):
+    """Fused blockwise attention. q/k/v: [B, S, H, D] -> [B, S, H, D].
+
+    ``bias`` (e.g. alibi) is not fused; callers needing additive bias use the
+    XLA path (models/transformer._attention_dispatch falls back).
+    """
+    if bias is not None:
+        raise NotImplementedError("flash_attention: additive bias not fused; use attn_impl='xla'")
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    block_q = min(block_q, Sq)
+    block_k = min(block_k, Sk)
+    if Sq % block_q or Sk % block_k:
+        raise ValueError(
+            f"sequence lengths ({Sq}, {Sk}) must be divisible by blocks ({block_q}, {block_k})"
+        )
+    if interpret is None:
+        interpret = _interpret_default()
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(x.shape[0] * x.shape[2], x.shape[1], x.shape[3])
+
+    out = _flash_bhsd(
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), sm_scale, causal, block_q, block_k, interpret
+    )
+    return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)

@@ -1,0 +1,124 @@
+"""Inference engine + injection policy tests.
+
+Mirrors the reference's strategy (tests/unit/inference/test_inference.py):
+parametrize over HF architectures, build a TINY randomly-initialized HF model
+offline, convert it through the injection policy, and compare logits against
+the HF (torch CPU) implementation within tolerance. Plus KV-cache decoding
+correctness: incremental generation must equal argmax rollout of the full
+forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+import torch  # noqa: E402
+
+from deepspeed_tpu.inference import InferenceEngine  # noqa: E402
+from deepspeed_tpu.module_inject import policy_for, replace_module  # noqa: E402
+
+
+def _logits_hf(model, tokens):
+    with torch.no_grad():
+        out = model(torch.tensor(tokens, dtype=torch.long))
+    return out.logits.float().numpy()
+
+
+def _make(model_cls, config):
+    torch.manual_seed(0)
+    m = model_cls(config)
+    m.eval()
+    return m
+
+
+CASES = {
+    "gpt2": lambda: _make(
+        transformers.GPT2LMHeadModel,
+        transformers.GPT2Config(
+            vocab_size=211, n_positions=64, n_embd=32, n_layer=2, n_head=4
+        ),
+    ),
+    "opt": lambda: _make(
+        transformers.OPTForCausalLM,
+        transformers.OPTConfig(
+            vocab_size=211, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            ffn_dim=64, max_position_embeddings=64, word_embed_proj_dim=32,
+        ),
+    ),
+    "gpt_neox": lambda: _make(
+        transformers.GPTNeoXForCausalLM,
+        transformers.GPTNeoXConfig(
+            vocab_size=211, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=64, max_position_embeddings=64, rotary_pct=1.0,
+            use_parallel_residual=True,
+        ),
+    ),
+    "bloom": lambda: _make(
+        transformers.BloomForCausalLM,
+        transformers.BloomConfig(
+            vocab_size=211, hidden_size=32, n_layer=2, n_head=4,
+        ),
+    ),
+}
+
+
+@pytest.mark.parametrize("arch", sorted(CASES))
+def test_policy_logits_match_hf(arch):
+    hf = CASES[arch]()
+    model, params = replace_module(hf_model=hf, dtype=jnp.float32)
+    tokens = np.random.default_rng(0).integers(0, 211, size=(2, 16)).astype(np.int32)
+    ours = np.asarray(model.apply(params, jnp.asarray(tokens)))
+    ref = _logits_hf(hf, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_policy_for_unknown_raises():
+    class FakeCfg:
+        model_type = "mamba"
+
+    with pytest.raises(ValueError, match="no injection policy"):
+        policy_for(FakeCfg())
+
+
+def test_engine_forward_and_generate_consistency():
+    hf = CASES["gpt2"]()
+    engine = InferenceEngine(hf_model=hf, config={"dtype": "fp32"})
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, 211, size=(2, 8)).astype(np.int32)
+
+    gen = engine.generate(prompt, max_new_tokens=6, temperature=0.0)
+    assert gen.shape == (2, 6)
+
+    # reference rollout: full forward + argmax, token by token (no cache)
+    seq = prompt.copy()
+    for _ in range(6):
+        logits = np.asarray(engine.forward(seq))
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(gen, seq[:, 8:])
+
+
+def test_engine_generate_deterministic_and_sampled():
+    hf = CASES["gpt2"]()
+    engine = InferenceEngine(hf_model=hf, config={"dtype": "fp32"})
+    prompt = np.random.default_rng(2).integers(0, 211, size=(1, 4)).astype(np.int32)
+    a = engine.generate(prompt, max_new_tokens=5, temperature=0.0)
+    b = engine.generate(prompt, max_new_tokens=5, temperature=0.0)
+    np.testing.assert_array_equal(a, b)
+    s1 = engine.generate(prompt, max_new_tokens=5, temperature=1.0, rng=jax.random.PRNGKey(7))
+    s2 = engine.generate(prompt, max_new_tokens=5, temperature=1.0, rng=jax.random.PRNGKey(8))
+    assert s1.shape == (1, 5) and s2.shape == (1, 5)
+    assert not np.array_equal(s1, s2) or True  # different keys usually differ; shape is the contract
+
+
+def test_engine_tensor_parallel_mesh():
+    """TP=2 over the 8-device mesh: logits must match single-device engine."""
+    hf = CASES["gpt2"]()
+    e1 = InferenceEngine(hf_model=hf, config={"dtype": "fp32"})
+    e2 = InferenceEngine(hf_model=hf, config={"dtype": "fp32", "tensor_parallel": {"tp_size": 2}})
+    tokens = np.random.default_rng(3).integers(0, 211, size=(2, 8)).astype(np.int32)
+    l1 = np.asarray(e1.forward(tokens))
+    l2 = np.asarray(e2.forward(tokens))
+    np.testing.assert_allclose(l1, l2, rtol=1e-4, atol=1e-4)
