@@ -1,0 +1,62 @@
+"""Bandwidth math + timing helpers for collective micro-benchmarks.
+
+Reference: ``benchmarks/communication/utils.py`` (+ bus-bw formulas in
+``deepspeed/utils/comms_logging.py:23``): algorithm bandwidth = bytes/time;
+bus bandwidth applies the collective's traffic factor so numbers are
+comparable across collectives and to NICs:
+
+    all_reduce:      2 (n-1) / n
+    all_gather:        (n-1) / n      (payload = full gathered size)
+    reduce_scatter:    (n-1) / n
+    all_to_all:        (n-1) / n
+    broadcast / p2p:   1
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def bus_bw_factor(op: str, n: int) -> float:
+    if n <= 1:
+        return 1.0
+    if op == "all_reduce":
+        return 2.0 * (n - 1) / n
+    if op in ("all_gather", "reduce_scatter", "all_to_all"):
+        return (n - 1) / n
+    return 1.0
+
+
+def time_fn(fn, *args, warmup: int = 2, iters: int = 10) -> float:
+    """Median-of-iters wall time of a jitted collective (seconds)."""
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def fmt_size(nbytes: int) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if nbytes < 1024:
+            return f"{nbytes:.0f}{unit}"
+        nbytes /= 1024
+    return f"{nbytes:.0f}TB"
+
+
+def report_line(op: str, nbytes: int, seconds: float, n_devices: int) -> str:
+    alg = nbytes / seconds / 1e9
+    bus = alg * bus_bw_factor(op, n_devices)
+    return (
+        f"{op:16s} {fmt_size(nbytes):>8s} {seconds*1e3:10.3f} ms "
+        f"algbw {alg:8.2f} GB/s  busbw {bus:8.2f} GB/s"
+    )

@@ -50,7 +50,13 @@ def init_distributed(
         return
     if coordinator_address is None:
         coordinator_address = os.environ.get("DSTPU_COORDINATOR")
-    if coordinator_address is not None:
+    if num_processes is None and os.environ.get("DSTPU_NUM_PROCESSES"):
+        num_processes = int(os.environ["DSTPU_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("DSTPU_PROCESS_ID"):
+        process_id = int(os.environ["DSTPU_PROCESS_ID"])
+    # num_processes=None lets jax.distributed auto-detect (TPU pod metadata);
+    # only an explicit single-process launch skips rendezvous.
+    if coordinator_address is not None and num_processes != 1:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

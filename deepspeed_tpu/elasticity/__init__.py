@@ -1,0 +1,9 @@
+"""Elastic training (reference: deepspeed/elasticity/)."""
+
+from .elasticity import (
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    get_valid_gpus,
+)

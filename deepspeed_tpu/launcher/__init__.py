@@ -1,0 +1,1 @@
+"""Launcher (reference: deepspeed/launcher/)."""

@@ -1,0 +1,80 @@
+"""Per-node launcher.
+
+Reference: ``deepspeed/launcher/launch.py`` — ``main`` (:123) spawns one
+process per local CUDA rank with RANK/LOCAL_RANK/MASTER_* env and kills the
+tree on failure (``terminate_process_tree`` :109, sigkill handler :284).
+
+TPU-native: ONE child per host — a JAX process addresses every local chip —
+with ``jax.distributed`` rendezvous env. The failure-handling contract is
+kept: the child is its own process group; on child failure or signal the
+whole group is terminated so no orphaned TPU clients hold the chips
+(cf. SURVEY.md §5 "failure detection").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+from ..utils.logging import logger
+
+
+def parse_args(args=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--node_rank", type=int, required=True)
+    p.add_argument("--num_nodes", type=int, required=True)
+    p.add_argument("--coordinator", type=str, required=True)
+    p.add_argument("--world_info", type=str, default="")
+    p.add_argument("user_script", type=str)
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p.parse_args(args)
+
+
+def terminate_process_tree(pid: int, sig=signal.SIGTERM) -> None:
+    """Kill the child's whole process group (reference launch.py:109)."""
+    try:
+        os.killpg(os.getpgid(pid), sig)
+    except ProcessLookupError:
+        pass
+
+
+def child_env(node_rank: int, num_nodes: int, coordinator: str, world_info: str) -> dict:
+    env = dict(os.environ)
+    env.update(
+        # consumed by deepspeed_tpu.comm.init_distributed -> jax.distributed
+        DSTPU_COORDINATOR=coordinator,
+        DSTPU_NUM_PROCESSES=str(num_nodes),
+        DSTPU_PROCESS_ID=str(node_rank),
+        DSTPU_WORLD_INFO=world_info,
+        # reference-compatible spellings some user scripts read
+        RANK=str(node_rank),
+        WORLD_SIZE=str(num_nodes),
+        LOCAL_RANK="0",
+    )
+    return env
+
+
+def main(args=None):
+    args = parse_args(args)
+    env = child_env(args.node_rank, args.num_nodes, args.coordinator, args.world_info)
+    cmd = [sys.executable, args.user_script] + list(args.user_args)
+    logger.info(f"node {args.node_rank}/{args.num_nodes}: exec {cmd}")
+    proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+
+    def handler(signum, frame):
+        logger.warning(f"signal {signum}: terminating child tree")
+        terminate_process_tree(proc.pid, signal.SIGTERM)
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+    rc = proc.wait()
+    if rc != 0:
+        terminate_process_tree(proc.pid, signal.SIGKILL)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

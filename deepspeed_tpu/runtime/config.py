@@ -261,6 +261,8 @@ class ElasticityConfig:
     version: float = 0.1
     ignore_non_elastic_batch_info: bool = False
     prefer_larger_batch: bool = True
+    model_parallel_size: int = 1
+    num_gpus_per_node: int = 1
 
 
 @dataclass

@@ -179,6 +179,13 @@ class DeepSpeedEngine:
             "good_steps": dist.replicated(self.mesh),
         }
 
+        # curriculum learning (reference engine hook: engine.py:1636-1642)
+        self.curriculum_scheduler = None
+        if self.config.curriculum_learning.enabled:
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(self.config.curriculum_learning)
+
         self._train_step = None  # compiled lazily (shape-dependent)
         self._grad_fn = None
         self._apply_fn = None
@@ -322,6 +329,8 @@ class DeepSpeedEngine:
         """
         if self._train_step is None:
             self._train_step = self._build_train_step()
+        if self.curriculum_scheduler is not None:
+            batch = self._apply_curriculum(batch)
         self.tput_timer.start()
         self.state, metrics = self._train_step(self.state, batch)
         metrics = jax.device_get(metrics)
@@ -339,6 +348,41 @@ class DeepSpeedEngine:
             ]
         )
         return metrics
+
+    def _apply_curriculum(self, batch: dict) -> dict:
+        """Seqlen curriculum: truncate token sequences to the scheduled
+        difficulty (reference: engine.py:1636 + curriculum_scheduler). Each
+        distinct length compiles once; difficulty_step bounds the count."""
+        seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps)
+
+        def trunc(x):
+            if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] > seqlen + 1:
+                return x[:, : seqlen + 1]  # +1: causal LM shift consumes one
+            return x
+
+        return {k: trunc(v) for k, v in batch.items()}
+
+    def deepspeed_io(self, dataset, batch_size: Optional[int] = None, **kw):
+        """Build a DP-aware dataloader (reference: engine.py:1518). Each
+        process yields its slice of the global batch: global train_batch_size
+        / process_count samples per step."""
+        from .dataloader import DeepSpeedDataLoader
+
+        n_proc = jax.process_count()
+        if batch_size is None:
+            assert self.train_batch_size % n_proc == 0, (
+                f"train_batch_size {self.train_batch_size} not divisible by "
+                f"{n_proc} processes"
+            )
+            batch_size = self.train_batch_size // n_proc
+        return DeepSpeedDataLoader(
+            dataset,
+            batch_size=batch_size,
+            num_replicas=n_proc,
+            rank=jax.process_index(),
+            drop_last=self.config.dataloader_drop_last,
+            **kw,
+        )
 
     def _report_progress(self, metrics):
         log_dist(

@@ -1,0 +1,251 @@
+"""Launcher parsing, data pipeline, curriculum, and elasticity tests
+(reference analogues: tests/unit/launcher/test_run.py, elasticity/test_elastic.py)."""
+
+import os
+import tempfile
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    get_valid_gpus,
+)
+from deepspeed_tpu.launcher.runner import (
+    decode_world_info,
+    encode_world_info,
+    fetch_hostfile,
+    parse_resource_filter,
+)
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+
+
+# ---------------------------------------------------------------------------
+# hostfile / filters
+# ---------------------------------------------------------------------------
+
+def _hostfile(text):
+    f = tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False)
+    f.write(text)
+    f.close()
+    return f.name
+
+
+def test_fetch_hostfile():
+    path = _hostfile("worker-0 slots=4\nworker-1 slots=8  # trailing comment\n\n# full comment\n")
+    pool = fetch_hostfile(path)
+    assert pool == OrderedDict({"worker-0": 4, "worker-1": 8})
+    os.unlink(path)
+
+
+def test_fetch_hostfile_missing_returns_empty():
+    assert fetch_hostfile("/nonexistent/hostfile") == OrderedDict()
+
+
+def test_fetch_hostfile_duplicate_raises():
+    path = _hostfile("w0 slots=2\nw0 slots=4\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        fetch_hostfile(path)
+    os.unlink(path)
+
+
+def test_resource_filters():
+    pool = OrderedDict({"w0": 4, "w1": 4, "w2": 2})
+    inc = parse_resource_filter(pool, include_str="w0@w1:0,2")
+    assert inc == OrderedDict({"w0": [0, 1, 2, 3], "w1": [0, 2]})
+    exc = parse_resource_filter(pool, exclude_str="w1")
+    assert list(exc) == ["w0", "w2"]
+    exc2 = parse_resource_filter(pool, exclude_str="w2:0,1")
+    assert "w2" not in exc2
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        parse_resource_filter(pool, include_str="w0", exclude_str="w1")
+    with pytest.raises(ValueError, match="not in hostfile"):
+        parse_resource_filter(pool, include_str="w9")
+
+
+def test_world_info_roundtrip():
+    active = OrderedDict({"w0": [0, 1], "w1": [0]})
+    assert decode_world_info(encode_world_info(active)) == {"w0": [0, 1], "w1": [0]}
+
+
+# ---------------------------------------------------------------------------
+# dataloader
+# ---------------------------------------------------------------------------
+
+def test_dataloader_shards_across_ranks():
+    data = [{"x": np.array([i])} for i in range(16)]
+    seen = []
+    for rank in range(2):
+        dl = DeepSpeedDataLoader(data, batch_size=2, num_replicas=2, rank=rank, shuffle=False)
+        for batch in dl:
+            seen.extend(batch["x"].ravel().tolist())
+    assert sorted(seen) == list(range(16))
+
+
+def test_dataloader_shuffle_epochs_differ():
+    data = [{"x": np.array([i])} for i in range(32)]
+    dl = DeepSpeedDataLoader(data, batch_size=32, shuffle=True, seed=1)
+    dl.set_epoch(0)
+    e0 = next(iter(dl))["x"].ravel().tolist()
+    dl.set_epoch(1)
+    e1 = next(iter(dl))["x"].ravel().tolist()
+    assert e0 != e1 and sorted(e0) == sorted(e1)
+
+
+def test_repeating_loader():
+    dl = DeepSpeedDataLoader([{"x": np.array([i])} for i in range(4)], batch_size=2)
+    rl = RepeatingLoader(dl)
+    vals = [next(rl)["x"].ravel().tolist() for _ in range(5)]
+    assert len(vals) == 5  # wrapped past the end without StopIteration
+
+
+# ---------------------------------------------------------------------------
+# curriculum
+# ---------------------------------------------------------------------------
+
+def test_curriculum_fixed_linear():
+    s = CurriculumScheduler(
+        {
+            "enabled": True,
+            "min_difficulty": 8,
+            "max_difficulty": 128,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8},
+        }
+    )
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(50) == 64  # halfway: 8 + 0.5*120 = 68 -> floor to 64
+    assert s.get_difficulty(100) == 128
+    assert s.get_difficulty(10**6) == 128
+    for step in range(0, 200, 7):  # always a multiple of difficulty_step, in range
+        d = s.get_difficulty(step)
+        assert d % 8 == 0 and 8 <= d <= 128
+
+
+def test_curriculum_fixed_discrete():
+    s = CurriculumScheduler(
+        {
+            "enabled": True,
+            "min_difficulty": 8,
+            "max_difficulty": 64,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [8, 32, 64], "max_step": [10, 20]},
+        }
+    )
+    assert s.get_difficulty(5) == 8
+    assert s.get_difficulty(15) == 32
+    assert s.get_difficulty(25) == 64
+
+
+def test_curriculum_root_monotone():
+    s = CurriculumScheduler(
+        {
+            "enabled": True,
+            "min_difficulty": 8,
+            "max_difficulty": 1024,
+            "schedule_type": "fixed_root",
+            "schedule_config": {"total_curriculum_step": 1000, "root_degree": 2},
+        }
+    )
+    ds = [s.get_difficulty(t) for t in range(0, 1100, 50)]
+    assert ds == sorted(ds) and ds[-1] == 1024
+
+
+def test_curriculum_engine_truncation():
+    """Engine hook truncates token seqlen to the scheduled difficulty."""
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+    model = Model(
+        TransformerConfig(
+            vocab_size=101, max_seq_len=64, num_layers=1, num_heads=2,
+            hidden_size=16, dtype=jnp.float32, loss_chunk_size=0,
+        )
+    )
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 100,
+        "curriculum_learning": {
+            "enabled": True,
+            "min_difficulty": 8,
+            "max_difficulty": 32,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 8},
+        },
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    toks = np.random.default_rng(0).integers(0, 101, size=(8, 33)).astype(np.int32)
+    for _ in range(3):
+        m = engine.train_batch({"tokens": toks})
+        assert np.isfinite(float(m["loss"]))
+    assert engine.curriculum_scheduler.get_current_difficulty() > 8
+
+
+# ---------------------------------------------------------------------------
+# elasticity
+# ---------------------------------------------------------------------------
+
+def test_get_valid_gpus():
+    assert get_valid_gpus(24, [2, 3], 1, 12) == [1, 2, 3, 4, 6, 8, 12]
+
+
+def test_compute_elastic_config_basic():
+    cfg = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 100,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 16,
+            "version": 0.1,
+        }
+    }
+    batch, valid = compute_elastic_config(cfg)
+    assert batch <= 100 and valid
+    # every valid world size can realize the batch with an allowed micro batch
+    for g in valid:
+        assert any(batch % (m * g) == 0 for m in [2, 4])
+
+
+def test_compute_elastic_config_world_size():
+    cfg = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 96,
+            "micro_batch_sizes": [2, 4, 6],
+            "min_gpus": 1,
+            "max_gpus": 8,
+            "version": 0.1,
+        }
+    }
+    batch, valid, micro = compute_elastic_config(cfg, world_size=4)
+    assert batch % (micro * 4) == 0
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, world_size=7)
+
+
+def test_single_node_launch_end_to_end(tmp_path):
+    """dstpu single-node launch actually runs a user script."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os\n"
+        "assert os.environ['DSTPU_NUM_PROCESSES'] == '1'\n"
+        "assert 'DSTPU_COORDINATOR' in os.environ\n"
+        "print('LAUNCHED-OK', os.environ['RANK'])\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--hostfile", "/nonexistent", str(script)],
+        capture_output=True, text=True, cwd="/root/repo", timeout=120,
+    )
+    assert "LAUNCHED-OK 0" in out.stdout, (out.stdout, out.stderr)
