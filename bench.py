@@ -6,23 +6,40 @@ Baseline anchor: the reference's single-device headline is BERT-large at
 64 TFLOPS/GPU on V100 (BASELINE.md row 1). We report achieved model TFLOPS
 per chip on a decoder-only 125M model (seq 1024, bf16) and vs_baseline =
 achieved_TFLOPS / 64.0.
+
+Robustness (VERDICT r01 weak #1): TPU backend init can fail transiently
+(UNAVAILABLE while the tunnel comes up). JAX caches backend-init failures
+per process, so retries happen in a parent/child subprocess loop: the child
+runs the real bench; the parent retries with backoff, falls back to CPU,
+and ALWAYS emits exactly one JSON line on stdout.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+_CHILD_ENV = "_DSTPU_BENCH_CHILD"
 
 
 def main():
     import jax
+
+    # env JAX_PLATFORMS alone is not honored when a site plugin hooks backend
+    # init (observed with the axon TPU plugin) — config.update is
+    plat_env = os.environ.get("JAX_PLATFORMS")
+    if plat_env:
+        jax.config.update("jax_platforms", plat_env)
+
     import jax.numpy as jnp
+    import numpy as np
 
     import deepspeed_tpu
     from deepspeed_tpu.models.transformer import Model, TransformerConfig
 
     platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
+    on_tpu = platform != "cpu"  # axon tunnel may report 'tpu' or 'axon'
 
     # GPT-2 small (125M): 12L, 768h, 12 heads, vocab 50257, seq 1024.
     if on_tpu:
@@ -40,6 +57,7 @@ def main():
         pos_emb="learned",
         dtype=jnp.bfloat16,
         remat=on_tpu,  # activation checkpointing over the layer scan
+        attn_impl="flash" if on_tpu else "xla",
     )
     model = Model(cfg)
     ds_cfg = {
@@ -73,9 +91,11 @@ def main():
     n_chips = len(jax.devices())
     tok_s_chip = tok_s / n_chips
 
-    # 6*N FLOPs/token (fwd+bwd) + attention term
+    # 6*N FLOPs/token (fwd+bwd) + attention term (12*S*D per layer per token:
+    # QK^T + AV, 2*S*D MACs each fwd, x3 for fwd+bwd — same convention as
+    # models/transformer.py flops_per_token)
     n_params = L * (4 * D * D + 8 * D * D) + V * D + S * D
-    attn_flops = L * 12 * S * D  # qk^T + av fwd+bwd per token
+    attn_flops = L * 12 * S * D
     flops_per_token = 6 * n_params + attn_flops
     tflops = tok_s_chip * flops_per_token / 1e12
 
@@ -88,8 +108,84 @@ def main():
         "platform": platform,
         "n_chips": n_chips,
     }
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
+    sys.stdout.flush()
+    os._exit(0)  # plugin background threads can hang interpreter teardown
+
+
+def _extract_json_line(text):
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+                if isinstance(obj, dict) and "metric" in obj:
+                    return line
+            except ValueError:
+                continue
+    return None
+
+
+def _run_child(extra_env, timeout):
+    env = dict(os.environ)
+    env[_CHILD_ENV] = "1"
+    env.update(extra_env)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        # salvage a JSON line if the child printed one then hung at exit
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        line = _extract_json_line(out)
+        if line:
+            return line, None
+        return None, "timeout"
+    line = _extract_json_line(proc.stdout)
+    if proc.returncode == 0 and line:
+        return line, None
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-5:]
+    return None, f"rc={proc.returncode}: " + " | ".join(tail)
+
+
+def _parent():
+    errors = []
+    # up to 3 tries on the default (TPU) platform with backoff; a hung backend
+    # init (subprocess timeout) twice in a row means the tunnel is down — skip
+    # straight to the CPU fallback rather than burning the driver's budget
+    tries = tuple(
+        int(t) for t in os.environ.get("DSTPU_BENCH_TIMEOUTS", "900,600,600").split(",")
+    )
+    for attempt, child_timeout in enumerate(tries):
+        if attempt:
+            time.sleep(min(15 * attempt, 45))
+        line, err = _run_child({}, timeout=child_timeout)
+        if line:
+            print(line, flush=True)
+            return 0
+        errors.append(err)
+        print(f"[bench] attempt {attempt + 1} failed: {err}", file=sys.stderr, flush=True)
+        if attempt >= 1 and errors[-1] == "timeout" and errors[-2] == "timeout":
+            break
+    # CPU fallback so a number is always recorded
+    line, err = _run_child({"JAX_PLATFORMS": "cpu"}, timeout=900)
+    if line:
+        print(line, flush=True)
+        return 0
+    errors.append(err)
+    print(json.dumps({
+        "metric": "gpt2-125M bf16 train throughput (achieved TFLOPS/chip)",
+        "value": 0.0,
+        "unit": "TFLOPS/chip",
+        "vs_baseline": 0.0,
+        "error": "; ".join(str(e) for e in errors)[-500:],
+    }), flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get(_CHILD_ENV) == "1":
+        main()
+    else:
+        sys.exit(_parent())

@@ -77,8 +77,10 @@ def build_op(op: str, mesh: Mesh, shape):
 
 def run(op: str, mesh: Mesh, nbytes: int, dtype=jnp.float32) -> str:
     n = mesh.shape["x"]
-    elems = max(n, nbytes // jnp.dtype(dtype).itemsize)
-    elems = (elems // n) * n
+    # multiple of n*n: the per-device [1, C] shard must split C into n chunks
+    # for all_to_all, so C % n == 0 i.e. elems % n*n == 0
+    elems = max(n * n, nbytes // jnp.dtype(dtype).itemsize)
+    elems = (elems // (n * n)) * (n * n)
     x = jnp.arange(elems, dtype=dtype).reshape(n, -1)
     x = jax.device_put(x, NamedSharding(mesh, P("x")))
     fn = build_op(op, mesh, x.shape)

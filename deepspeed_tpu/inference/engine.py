@@ -62,6 +62,12 @@ class InferenceEngine:
             }
             if dtype not in table:
                 raise ValueError(f"unsupported dtype {dtype!r}; one of {sorted(table)}")
+            if dtype in ("fp16", "half"):
+                log_dist(
+                    "inference dtype fp16 requested: TPU has no fp16 matmul path, "
+                    "using bfloat16 (same memory, wider exponent)",
+                    ranks=[0],
+                )
             dtype = table[dtype]
 
         if hf_model is not None or state_dict is not None:
@@ -93,7 +99,16 @@ class InferenceEngine:
         if params is None:
             params = jax.jit(model.init, out_shardings=shardings)(jax.random.PRNGKey(0))
         else:
-            params = jax.tree.map(lambda x: np.asarray(x, dtype=np.float32), params)
+            # weights live in the engine dtype (bf16 halves HBM vs fp32, like
+            # the reference's module.half() conversion); ints (e.g. rotary
+            # position tables) keep their dtype
+            np_dtype = np.dtype(jnp.dtype(dtype).name)
+
+            def _cast(x):
+                x = np.asarray(x)
+                return x.astype(np_dtype) if np.issubdtype(x.dtype, np.floating) else x
+
+            params = jax.tree.map(_cast, params)
             params = jax.device_put(params, shardings)
         self.params = params
 

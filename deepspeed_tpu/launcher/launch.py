@@ -34,9 +34,13 @@ def parse_args(args=None):
 
 
 def terminate_process_tree(pid: int, sig=signal.SIGTERM) -> None:
-    """Kill the child's whole process group (reference launch.py:109)."""
+    """Kill the child's whole process group (reference launch.py:109).
+
+    The child was started with start_new_session=True, so its pgid equals its
+    pid — signal the group directly. (os.getpgid(pid) would raise once the
+    child is reaped, silently skipping surviving grandchildren.)"""
     try:
-        os.killpg(os.getpgid(pid), sig)
+        os.killpg(pid, sig)
     except ProcessLookupError:
         pass
 

@@ -15,9 +15,19 @@ online-softmax ("flash") attention pair of kernels:
     accumulates dQ over k-blocks; the softmax Jacobian term uses
     D_i = rowsum(dO ∘ O) computed in plain XLA.
 
-Causal masking skips fully-masked blocks via dynamic loop bounds (the block
-analogue of the reference's triangular softmax kernels). On non-TPU backends
-the kernels run in Pallas interpreter mode so tests exercise the same code.
+VMEM residency is O(block) not O(sequence): the streamed operand rides the
+*innermost grid dimension* (its BlockSpec indexes that dim), so Pallas
+double-buffers one block at a time from HBM while the online-softmax /
+gradient state lives in VMEM scratch accumulators that persist across the
+sequential innermost grid steps (output blocks are revisited, written once
+when the stream finishes). This keeps per-program VMEM at a few hundred KB
+at any sequence length — whole-sequence BlockSpecs would blow the ~16 MB
+VMEM budget at 8-16k tokens.
+
+Causal masking skips the compute (not the grid step) of fully-masked blocks
+via ``pl.when`` — the block analogue of the reference's triangular softmax
+kernels. On non-TPU backends the kernels run in Pallas interpreter mode so
+tests exercise the same code.
 
 Layout: public API takes [B, S, H, D] (the model family's layout) and maps
 over fused batch×head programs internally.
@@ -57,93 +67,24 @@ def _vmem_spec(shape, index_map):
     return pl.BlockSpec(shape, index_map)
 
 
-# ---------------------------------------------------------------------------
-# Forward
-# ---------------------------------------------------------------------------
-
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k):
-    qi = pl.program_id(1)
-    block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    seq_k = k_ref.shape[1]
-    num_k = seq_k // block_k
-
-    q = q_ref[0]  # [Bq, D] native dtype — MXU runs at full rate in bf16
-
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-
-    def body(kj, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :]
-        s = sm_scale * jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [Bq, Bk] fp32 accumulator
-        if causal:
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l, acc
-
-    if causal:
-        # blocks at or before the diagonal: kj*Bk <= qi*Bq + Bq - 1
-        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        hi = jnp.minimum(hi, num_k)
-    else:
-        hi = num_k
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # LSE broadcast over a 128-lane trailing axis to satisfy TPU tiling
-    lse = m + jnp.log(l_safe)
-    lse_ref[0] = jnp.broadcast_to(lse[:, None], (block_q, LANES))
+def _scratch(shape):
+    if _VMEM is None:  # pragma: no cover - pltpu import failed entirely
+        raise RuntimeError("pallas TPU memory spaces unavailable; use attn_impl='xla'")
+    return _VMEM(shape, jnp.float32)
 
 
-def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    BH, Sq, D = q.shape
-    Sk = k.shape[1]
-    grid = (BH, Sq // block_q)
-    kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k
-    )
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            _vmem_spec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            _vmem_spec((1, Sk, D), lambda bh, qi: (bh, 0, 0)),
-            _vmem_spec((1, Sk, D), lambda bh, qi: (bh, 0, 0)),
-        ],
-        out_specs=[
-            _vmem_spec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            _vmem_spec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, Sq, LANES), jnp.float32),
-        ],
-        interpret=interpret,
-    )(q, k, v)
-    return out, lse
-
-
-# ---------------------------------------------------------------------------
-# Backward
-# ---------------------------------------------------------------------------
+def _compiler_params(grid_len):
+    """Mark every grid dim except the innermost (the sequential stream over
+    which scratch accumulates) as parallel."""
+    if pltpu is None:
+        return None
+    CP = getattr(pltpu, "CompilerParams", None) or getattr(pltpu, "TPUCompilerParams", None)
+    if CP is None:
+        return None
+    try:
+        return CP(dimension_semantics=("parallel",) * (grid_len - 1) + ("arbitrary",))
+    except TypeError:  # pragma: no cover - signature drift
+        return None
 
 
 def _widen(lane_tile, width):
@@ -155,30 +96,135 @@ def _widen(lane_tile, width):
     return lane_tile[:, :width]
 
 
+def _lanes(col, lanes=LANES):
+    """[rows] -> [rows, lanes] broadcast."""
+    return jnp.broadcast_to(col[:, None], (col.shape[0], lanes))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale, causal, num_k,
+):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0]          # [Bq, D] native dtype — MXU runs at full rate in bf16
+        k_blk = k_ref[0]      # [Bk, D]
+        v_blk = v_ref[0]
+        s = sm_scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Bq, Bk] fp32 accumulator
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[...]                     # [Bq, LANES] lane-broadcast
+        m_new = jnp.maximum(m_prev, _lanes(jnp.max(s, axis=1)))
+        p = jnp.exp(s - _widen(m_new, block_k))
+        alpha = jnp.exp(m_prev - m_new)         # [Bq, LANES]
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + _lanes(jnp.sum(p, axis=1))
+        acc_scr[...] = acc_scr[...] * alpha[:, 0:1] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # skip blocks strictly above the diagonal: kj*Bk > qi*Bq + Bq - 1
+        pl.when(kj * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        l = l_scr[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe[:, 0:1]).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(l_safe)
+
+
+def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    num_k = Sk // block_k
+    grid = (BH, Sq // block_q, num_k)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, num_k=num_k
+    )
+    kwargs = {}
+    cp = _compiler_params(len(grid))
+    if cp is not None and not interpret:
+        kwargs["compiler_params"] = cp
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _vmem_spec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            _vmem_spec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+            _vmem_spec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            _vmem_spec((1, block_q, LANES), lambda bh, qi, kj: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            _scratch((block_q, LANES)),   # running row-max m
+            _scratch((block_q, LANES)),   # running row-sum l
+            _scratch((block_q, D)),       # output accumulator
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
 
 def _bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, sm_scale, causal, block_q,
+    dk_scr, dv_scr, *, sm_scale, causal, num_q,
 ):
     kj = pl.program_id(1)
+    qi = pl.program_id(2)
     block_k = k_ref.shape[1]
-    d = k_ref.shape[2]
-    seq_q = q_ref.shape[1]
-    num_q = seq_q // block_q
+    block_q = q_ref.shape[1]
 
-    k_blk = k_ref[0]  # [Bk, D]
-    v_blk = v_ref[0]
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
-    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-
-    def body(qi, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :]
-        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]      # [Bq, LANES]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]  # [Bq, LANES]
+    def _compute():
+        k_blk = k_ref[0]      # [Bk, D]
+        v_blk = v_ref[0]
+        q_blk = q_ref[0]      # [Bq, D]
+        do_blk = do_ref[0]
+        lse = lse_ref[0]      # [Bq, LANES]
+        delta = delta_ref[0]  # [Bq, LANES]
 
         s = sm_scale * jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -187,10 +233,13 @@ def _bwd_dkdv_kernel(
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - _widen(lse, block_k))  # [Bq, Bk]
         # dV += P^T dO
-        dv = dv + jax.lax.dot_general(
+        dv_scr[...] += jax.lax.dot_general(
             p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -200,44 +249,50 @@ def _bwd_dkdv_kernel(
         )
         ds = p * (dp - _widen(delta, block_k))
         # dK += dS^T Q · scale
-        dk = dk + sm_scale * jax.lax.dot_general(
+        dk_scr[...] += sm_scale * jax.lax.dot_general(
             ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk, dv
 
     if causal:
-        lo = jax.lax.div(kj * block_k, block_q)
+        # q-blocks entirely above the diagonal contribute nothing to this k-block
+        pl.when(qi * block_q + block_q - 1 >= kj * block_k)(_compute)
     else:
-        lo = 0
-    dk, dv = jax.lax.fori_loop(lo, num_q, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        _compute()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, sm_scale, causal, block_k,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, sm_scale, causal, num_k,
 ):
     qi = pl.program_id(1)
+    kj = pl.program_id(2)
     block_q = q_ref.shape[1]
-    d = q_ref.shape[2]
-    seq_k = k_ref.shape[1]
-    num_k = seq_k // block_k
+    block_k = k_ref.shape[1]
 
-    q_blk = q_ref[0]
-    do_blk = do_ref[0]
-    lse = lse_ref[0]      # [Bq, LANES]
-    delta = delta_ref[0]  # [Bq, LANES]
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    def body(kj, dq):
-        k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :]
+    def _compute():
+        q_blk = q_ref[0]
+        do_blk = do_ref[0]
+        lse = lse_ref[0]      # [Bq, LANES]
+        delta = delta_ref[0]  # [Bq, LANES]
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         s = sm_scale * jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
             k_pos = kj * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
@@ -247,68 +302,80 @@ def _bwd_dq_kernel(
             do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - _widen(delta, block_k))
-        return dq + sm_scale * jax.lax.dot_general(
+        dq_scr[...] += sm_scale * jax.lax.dot_general(
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
     if causal:
-        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        hi = jnp.minimum(hi, num_k)
+        pl.when(kj * block_k <= qi * block_q + block_q - 1)(_compute)
     else:
-        hi = num_k
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+        _compute()
+
+    @pl.when(kj == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _flash_backward(res, g, sm_scale, causal, block_q, block_k, interpret):
     q, k, v, out, lse = res
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    num_q = Sq // block_q
+    num_k = Sk // block_k
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [BH,Sq]
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
 
+    kwargs = {}
+    cp = _compiler_params(3)
+    if cp is not None and not interpret:
+        kwargs["compiler_params"] = cp
+
     dkdv = pl.pallas_call(
         functools.partial(
-            _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q
+            _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, num_q=num_q
         ),
-        grid=(BH, Sk // block_k),
+        grid=(BH, num_k, num_q),
         in_specs=[
-            _vmem_spec((1, Sq, D), lambda bh, kj: (bh, 0, 0)),
-            _vmem_spec((1, block_k, D), lambda bh, kj: (bh, kj, 0)),
-            _vmem_spec((1, block_k, D), lambda bh, kj: (bh, kj, 0)),
-            _vmem_spec((1, Sq, D), lambda bh, kj: (bh, 0, 0)),
-            _vmem_spec((1, Sq, LANES), lambda bh, kj: (bh, 0, 0)),
-            _vmem_spec((1, Sq, LANES), lambda bh, kj: (bh, 0, 0)),
+            _vmem_spec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
+            _vmem_spec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
+            _vmem_spec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q, LANES), lambda bh, kj, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q, LANES), lambda bh, kj, qi: (bh, qi, 0)),
         ],
         out_specs=[
-            _vmem_spec((1, block_k, D), lambda bh, kj: (bh, kj, 0)),
-            _vmem_spec((1, block_k, D), lambda bh, kj: (bh, kj, 0)),
+            _vmem_spec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
+            _vmem_spec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
             jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
         ],
+        scratch_shapes=[_scratch((block_k, D)), _scratch((block_k, D))],
         interpret=interpret,
+        **kwargs,
     )(q, k, v, g, lse, delta)
     dk, dv = dkdv
 
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k
+            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, num_k=num_k
         ),
-        grid=(BH, Sq // block_q),
+        grid=(BH, num_q, num_k),
         in_specs=[
-            _vmem_spec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            _vmem_spec((1, Sk, D), lambda bh, qi: (bh, 0, 0)),
-            _vmem_spec((1, Sk, D), lambda bh, qi: (bh, 0, 0)),
-            _vmem_spec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            _vmem_spec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
-            _vmem_spec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            _vmem_spec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+            _vmem_spec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+            _vmem_spec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+            _vmem_spec((1, block_q, LANES), lambda bh, qi, kj: (bh, qi, 0)),
+            _vmem_spec((1, block_q, LANES), lambda bh, qi, kj: (bh, qi, 0)),
         ],
-        out_specs=_vmem_spec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_specs=_vmem_spec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        scratch_shapes=[_scratch((block_q, D))],
         interpret=interpret,
+        **kwargs,
     )(q, k, v, g, lse, delta)
     return dq, dk, dv
 

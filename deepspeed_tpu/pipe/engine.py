@@ -127,6 +127,11 @@ class PipelineEngine(DeepSpeedEngine):
         gas = self.gradient_accumulation_steps
         if model.num_micro_batches in (1, gas):
             model.num_micro_batches = gas
+        elif gas == 1:
+            # config left gas at its default: adopt the model's microbatch
+            # count (the reference treats gas as the sole source but never
+            # errors when only the module specifies it)
+            pass
         else:
             raise ValueError(
                 f"gradient_accumulation_steps={gas} in the config conflicts with "
