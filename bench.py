@@ -75,15 +75,22 @@ def main():
     tokens = np.random.default_rng(0).integers(0, V, size=(B, S + 1)).astype(np.int32)
     batch = {"tokens": tokens}
 
-    # warmup (compile)
-    engine.train_batch(batch)
-    jax.block_until_ready(engine.state["params"]["wte"])
+    # block_until_ready is not a reliable sync on the tunneled axon backend;
+    # fetching a scalar from the step's own output is (perf_probe4.py).
+    def sync(m):
+        np.asarray(jax.device_get(m["loss"]))
+
+    # warmup (compile + 3 steady-state steps)
+    sync(engine.train_batch(batch))
+    for _ in range(3 if on_tpu else 1):
+        m = engine.train_batch(batch)
+    sync(m)
 
     steps = 20 if on_tpu else 3
     t0 = time.perf_counter()
     for _ in range(steps):
-        engine.train_batch(batch)
-    jax.block_until_ready(engine.state["params"]["wte"])
+        m = engine.train_batch(batch)
+    sync(m)
     dt = time.perf_counter() - t0
 
     tokens_per_step = B * S

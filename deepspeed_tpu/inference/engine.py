@@ -135,37 +135,49 @@ class InferenceEngine:
         # [L, B, Smax, H, Dh]: batch over data axes, heads over model axis
         return PartitionSpec(None, ("data", "fsdp"), None, "model", None)
 
-    def _build_generate(self, B: int, prompt_len: int, max_new: int):
+    def _build_generate(self, B: int, prompt_len: int, max_new: int, sampler_static: tuple):
+        from .sampling import SamplerConfig, sample_logits, update_seen
+
         cfg = self.cfg
         mesh = self.mesh
-        Smax = prompt_len + max_new
+        # cache rounded up to a 128 multiple: the Pallas decode kernel streams
+        # it in power-of-two blocks; positions past the live prefix are masked
+        Smax = -(-(prompt_len + max_new) // 128) * 128
         cache_sharding = NamedSharding(mesh, self._cache_spec())
+        top_k, top_p, rep_penalty = sampler_static
 
-        def sample(logits, rng, temperature):
-            # logits [B, V]
-            greedy = jnp.argmax(logits, axis=-1)
-            scaled = logits / jnp.maximum(temperature, 1e-6)
-            drawn = jax.random.categorical(rng, scaled, axis=-1)
-            return jnp.where(temperature <= 0.0, greedy, drawn).astype(jnp.int32)
+        use_seen = rep_penalty != 1.0  # skip the [B, V] history carry otherwise
 
         def gen(params, prompt, rng, temperature):
+            scfg = SamplerConfig(
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                repetition_penalty=rep_penalty,
+            )
             cache = tfm.init_cache(cfg, B, Smax, dtype=cfg.dtype)
             cache = jax.tree.map(
                 lambda x: jax.lax.with_sharding_constraint(x, cache_sharding), cache
             )
+            seen0 = (
+                update_seen(jnp.zeros((B, cfg.vocab_size), jnp.bool_), prompt)
+                if use_seen
+                else jnp.zeros((B, 1), jnp.bool_)  # dummy carry
+            )
             logits, cache = tfm.apply_with_cache(cfg, params, prompt, cache, 0, last_only=True)
             rng, k0 = jax.random.split(rng)
-            tok = sample(logits[:, -1], k0, temperature)
+            tok = sample_logits(logits[:, -1], k0, scfg, seen=seen0 if use_seen else None)
+            seen = update_seen(seen0, tok[:, None]) if use_seen else seen0
 
             def step(carry, _):
-                tok, cache, pos, rng = carry
+                tok, cache, pos, rng, seen = carry
                 logits, cache = tfm.apply_with_cache(cfg, params, tok[:, None], cache, pos)
                 rng, k = jax.random.split(rng)
-                nxt = sample(logits[:, 0], k, temperature)
-                return (nxt, cache, pos + 1, rng), tok
+                nxt = sample_logits(logits[:, 0], k, scfg, seen=seen if use_seen else None)
+                if use_seen:
+                    seen = update_seen(seen, nxt[:, None])
+                return (nxt, cache, pos + 1, rng, seen), tok
 
-            (last, _, _, _), toks = jax.lax.scan(
-                step, (tok, cache, prompt_len, rng), None, length=max_new - 1
+            (last, _, _, _, _), toks = jax.lax.scan(
+                step, (tok, cache, prompt_len, rng, seen), None, length=max_new - 1
             )
             # toks = tokens emitted before each step; append the final one
             return jnp.concatenate([toks.T, last[:, None]], axis=1)  # [B, max_new]
@@ -177,12 +189,18 @@ class InferenceEngine:
         prompt_tokens,
         max_new_tokens: int = 32,
         temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        repetition_penalty: float = 1.0,
         rng: Optional[jax.Array] = None,
     ) -> np.ndarray:
         """prompt [B, S] int32 -> generated [B, max_new_tokens] int32.
 
-        The whole loop (prefill + scan'd decode) is one compiled program per
-        (B, prompt_len, max_new_tokens) bucket."""
+        Sampling: temperature (<=0 greedy), top-k, top-p (nucleus), and
+        repetition penalty (CTRL-style over prompt + generated history). The
+        whole loop (prefill + scan'd decode with the Pallas decode-attention
+        kernel) is one compiled program per (B, prompt_len, max_new_tokens)
+        bucket."""
         prompt = jnp.asarray(prompt_tokens, jnp.int32)
         B, S = prompt.shape
         budget = min(self.cfg.max_seq_len, self.max_out_tokens)
@@ -192,9 +210,10 @@ class InferenceEngine:
                 f"sequence budget {budget} (min of model max_seq_len "
                 f"{self.cfg.max_seq_len} and max_out_tokens {self.max_out_tokens})"
             )
-        key = (B, S, max_new_tokens)
+        sampler_static = (int(top_k), float(top_p), float(repetition_penalty))
+        key = (B, S, max_new_tokens, sampler_static)
         if key not in self._generate:
-            self._generate[key] = self._build_generate(*key)
+            self._generate[key] = self._build_generate(B, S, max_new_tokens, sampler_static)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         out = self._generate[key](self.params, prompt, rng, jnp.float32(temperature))
         return np.asarray(jax.device_get(out))

@@ -1,0 +1,82 @@
+"""Token sampling for generative inference — temperature, top-k, top-p
+(nucleus), repetition penalty.
+
+The reference's inference stack leans on greedy/HF-side sampling; a real p50
+serving path needs the sampler inside the compiled decode loop, so these are
+pure jnp transforms on [B, V] logits usable under jit/scan.
+
+Repetition penalty is CTRL-style (as in HF generation): logits of tokens seen
+in the history are divided by the penalty when positive, multiplied when
+negative. The "seen" set is carried as a [B, V] bool mask updated per step —
+O(V) memory but branch-free under XLA.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+class SamplerConfig(NamedTuple):
+    temperature: jnp.ndarray | float = 1.0
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0  # 1.0 = disabled
+    repetition_penalty: float = 1.0  # 1.0 = disabled
+
+
+def update_seen(seen: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """seen [B, V] bool | tokens [B, T] -> seen with those tokens marked."""
+    B, V = seen.shape
+    onehot = jax.nn.one_hot(tokens, V, dtype=jnp.bool_)  # [B, T, V]
+    return seen | jnp.any(onehot, axis=1)
+
+
+def apply_repetition_penalty(logits, seen, penalty: float):
+    if penalty == 1.0:
+        return logits
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
+def apply_top_k(logits, k: int):
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    vals, _ = jax.lax.top_k(logits, k)
+    thresh = vals[..., -1:]
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def apply_top_p(logits, p: float):
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens while the cumulative mass BEFORE them is < p (the first
+    # token is always kept)
+    keep_sorted = (cum - probs) < p
+    # threshold = smallest kept logit
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def sample_logits(logits, rng, cfg: SamplerConfig, seen=None):
+    """logits [B, V] -> sampled token ids [B] int32.
+
+    temperature <= 0 selects greedy argmax (after repetition penalty)."""
+    logits = logits.astype(jnp.float32)
+    if seen is not None:
+        logits = apply_repetition_penalty(logits, seen, cfg.repetition_penalty)
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.asarray(cfg.temperature, jnp.float32)
+    scaled = logits / jnp.maximum(t, 1e-6)
+    scaled = apply_top_k(scaled, cfg.top_k)
+    scaled = apply_top_p(scaled, cfg.top_p)
+    drawn = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(t <= 0.0, greedy, drawn).astype(jnp.int32)

@@ -53,8 +53,16 @@ class TransformerConfig:
     activation: str = "gelu"  # gelu | gelu_exact | relu
     embed_ln: bool = False  # LayerNorm after embedding (BLOOM)
     attn_impl: str = "xla"  # xla | flash | ring
+    flash_block_q: int = 0  # 0 = auto (ops/pallas/flash_attention._auto_block)
+    flash_block_k: int = 0
+    decode_attn: str = "kernel"  # kernel (Pallas length-aware) | xla (dense)
     remat: bool = False  # activation checkpointing over the layer scan
-    remat_policy: str = "nothing_saveable"
+    # Remat policy names: any jax.checkpoint_policies attr, plus
+    #   "save_flash"      — save only the flash kernel's out/lse residuals so
+    #                       the Pallas forward never re-runs in backward
+    #   "dots_and_flash"  — dots_saveable + the flash residuals: no matmul or
+    #                       attention recompute, memory = all matmul outputs
+    remat_policy: str = "save_flash"
     dtype: Any = jnp.float32  # compute dtype (params always stored fp32)
     moe_every: int = 0  # >0: every Nth layer is an MoE FFN (see moe/)
     num_experts: int = 1
@@ -239,13 +247,26 @@ def xla_attention(q, k, v, *, causal_offset=0, bias=None, dtype=jnp.float32):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _remat_policy(name: str):
+    """Resolve a remat-policy name (TransformerConfig.remat_policy)."""
+    cp = jax.checkpoint_policies
+    flash_names = cp.save_only_these_names("flash_out", "flash_lse")
+    if name == "save_flash":
+        return flash_names
+    if name == "dots_and_flash":
+        return cp.save_from_both_policies(cp.dots_saveable, flash_names)
+    return getattr(cp, name, None)
+
+
 def _attention_dispatch(cfg: TransformerConfig):
     if cfg.attn_impl == "flash":
         from ..ops.pallas.flash_attention import flash_attention
 
+        bq = cfg.flash_block_q or None
+        bk = cfg.flash_block_k or None
         # additive bias (alibi) is not fused — those layers take the XLA path
         return lambda q, k, v, bias: (
-            flash_attention(q, k, v, causal=True)
+            flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
             if bias is None
             else xla_attention(q, k, v, bias=bias)
         )
@@ -354,7 +375,7 @@ def apply(
         return body(carry, lp)
 
     if cfg.remat:
-        policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+        policy = _remat_policy(cfg.remat_policy)
         scan_body = jax.checkpoint(scan_body, policy=policy, prevent_cse=False)
 
     aux_total = jnp.zeros((), jnp.float32)
@@ -442,6 +463,14 @@ def apply_with_cache(
         dist = jnp.arange(Smax)[None, :] - (pos + jnp.arange(T)[:, None])
         bias = (slopes[:, None, None] * dist[None]).astype(jnp.float32)[None]
 
+    # Single-token decode steps route through the Pallas length-aware kernel
+    # (ops/pallas/decode_attention.py — the reference's softmax_context,
+    # pt_binding.cpp:1237): it reads only cache blocks up to ``pos`` instead
+    # of the dense O(Smax) recompute. Alibi keeps the XLA path (bias unfused).
+    use_decode_kernel = T == 1 and cfg.decode_attn == "kernel" and cfg.pos_emb != "alibi"
+    if use_decode_kernel:
+        from ..ops.pallas.decode_attention import decode_attention
+
     def layer(carry, inputs):
         x = carry
         lp, k_cache, v_cache = inputs
@@ -449,7 +478,11 @@ def apply_with_cache(
         q, k, v = _qkv_proj(cfg, lp, h, positions)
         k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
-        attn_out = _attn_out_proj(cfg, lp, cached_attention(q, k_cache, v_cache, pos, bias=bias))
+        if use_decode_kernel:
+            attn = decode_attention(q[:, 0], k_cache, v_cache, pos)[:, None]
+        else:
+            attn = cached_attention(q, k_cache, v_cache, pos, bias=bias)
+        attn_out = _attn_out_proj(cfg, lp, attn)
         if cfg.parallel_residual:
             h2 = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_epsilon)
             x = x + attn_out + _ffn(cfg, lp, h2)

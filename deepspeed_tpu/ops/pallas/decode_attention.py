@@ -1,0 +1,148 @@
+"""Fused single-token decode attention over a KV cache (Pallas).
+
+The reference's generative-inference hot kernel is ``softmax_context`` —
+attention of one new token against the incremental KV cache, fused with the
+causal mask over the valid prefix (csrc/transformer/inference/csrc/
+pt_binding.cpp:1237-1283). The TPU failure mode it prevents is different from
+CUDA's: a dense XLA attention over the whole [Smax] cache re-reads the entire
+allocation every decoded token, so decode becomes O(Smax) HBM traffic no
+matter how short the sequence actually is.
+
+This kernel:
+  * processes one batch row per outer grid step, all H heads together (the
+    per-head work is a [H, D] x [D, Bk] matvec batch — decode attention is
+    HBM-bandwidth-bound, so the job is streaming k/v, not MXU utilization);
+  * streams the cache in ``block_k`` chunks along the innermost grid dim with
+    online softmax in VMEM scratch (same machinery as flash_attention);
+  * is length-aware via scalar prefetch: the per-row ``pos`` feeds the
+    BlockSpec index maps, which CLAMP out-of-range block indices to the last
+    valid block — Mosaic's pipeline emitter skips re-fetching a block whose
+    indices equal the previous step's, so blocks past ``pos`` cost neither
+    HBM bandwidth nor compute (``pl.when`` guards the FLOPs).
+
+Layout: q [B, H, D] (the new token, post-rotary), k/v cache [B, Smax, H, D],
+pos [B] int32 = index of the newest valid entry (keys [0, pos] attended).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                   *, sm_scale, block_k, num_kb):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[b]
+    jmax = pos // block_k
+
+    @pl.when(j <= jmax)
+    def _compute():
+        q = q_ref[0]        # [H, D]
+        k = k_ref[0]        # [Bk, H, D]
+        v = v_ref[0]
+        # s[h, kk] = sum_d q[h, d] * k[kk, h, d]
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))), preferred_element_type=jnp.float32
+        )  # [H, Bk]
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= pos, s, NEG_INF)
+        m_prev = m_scr[...]                       # [H, Bk] lane-broadcast tile
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev[:, 0:1] - m_new[:, 0:1])  # [H, 1]
+        m_scr[...] = jnp.broadcast_to(m_new[:, 0:1], m_scr.shape)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        # acc[h, d] += sum_kk p[h, kk] * v[kk, h, d]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )  # [H, D]
+        acc_scr[...] = acc_scr[...] * alpha + pv
+
+    @pl.when(j == num_kb - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None, block_k: int = 512,
+                     interpret: bool | None = None):
+    """q [B, H, D], k/v_cache [B, Smax, H, D], pos [B] or scalar int32 (index
+    of the newest valid cache entry) -> attention output [B, H, D].
+
+    Equivalent to ``xla_attention(q[:, None], k_cache, v_cache,
+    causal_offset=pos)[:, 0]`` but reads only the valid cache prefix.
+    """
+    B, H, D = q.shape
+    Smax = k_cache.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (D ** 0.5)
+    block_k = min(block_k, Smax)
+    while block_k > 1 and Smax % block_k:
+        block_k //= 2
+    if Smax % block_k:
+        raise ValueError(
+            f"cache length {Smax} has no power-of-two block divisor; allocate "
+            f"the KV cache rounded up to a multiple of 128 (inference engine "
+            f"does this automatically)"
+        )
+    num_kb = Smax // block_k
+    if interpret is None:
+        interpret = _interpret_default()
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+
+    if pltpu is None:
+        raise RuntimeError("pallas TPU support unavailable; use the XLA decode path")
+
+    def clamp(j, p_ref, b):
+        return jnp.minimum(j, p_ref[b] // block_k)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, j, p: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, H, D), lambda b, j, p: (b, clamp(j, p, b), 0, 0)),
+            pl.BlockSpec((1, block_k, H, D), lambda b, j, p: (b, clamp(j, p, b), 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, j, p: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, block_k), jnp.float32),
+            pltpu.VMEM((H, block_k), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, sm_scale=sm_scale, block_k=block_k, num_kb=num_kb
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+    )(pos, q, k_cache, v_cache)
+    return out

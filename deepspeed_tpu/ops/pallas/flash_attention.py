@@ -40,6 +40,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 
 try:  # pltpu is importable on non-TPU backends; kernels then run interpreted
@@ -51,10 +52,26 @@ except Exception:  # pragma: no cover
     _VMEM = None
 
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
 LANES = 128  # TPU lane width; LSE/delta are stored lane-broadcast
 NEG_INF = -1e30
+
+# Block-size policy. Grid-step overhead dominates tiny blocks on TPU: at
+# [B=64,H=12,S=1024,Dh=64] the 128x128 grid is 49k steps of ~4 MFLOP each and
+# the kernel measures 4.1 TFLOPS; 512/1024 blocks cut it to 1.5k steps and
+# 16 TFLOPS fwd / 32 f+b (see experiments/perf_probe2.py). Blocks are capped
+# so VMEM stays bounded at long sequence (the streamed operand still rides the
+# innermost grid dim).
+MAX_BLOCK_Q = 512
+MAX_BLOCK_K = 1024
+
+
+def _auto_block(s: int, cap: int) -> int:
+    """Largest power-of-two block <= cap that divides s (s is pre-padded to a
+    multiple of 128 by the public wrapper)."""
+    b = cap
+    while b > 128 and s % b:
+        b //= 2
+    return min(b, s)
 
 
 def _interpret_default() -> bool:
@@ -319,6 +336,7 @@ def _bwd_dq_kernel(
 
 def _flash_backward(res, g, sm_scale, causal, block_q, block_k, interpret):
     q, k, v, out, lse = res
+    lse = jnp.broadcast_to(lse[..., None], lse.shape + (LANES,))  # re-tile lanes
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     num_q = Sq // block_q
@@ -392,6 +410,13 @@ def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 def _flash_bhsd_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     out, lse = _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    # Under jax.checkpoint, out/lse are the residuals the backward kernels
+    # need; naming them lets a remat policy (models/transformer.py
+    # _remat_policy 'flash' names) save them so the forward kernel is NOT
+    # re-run inside the backward pass. lse is saved de-broadcast ([BH,S], not
+    # the lane-tiled [BH,S,LANES]) so the saved residual is 128x smaller.
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse[:, :, 0], "flash_lse")
     return out, (q, k, v, out, lse)
 
 
@@ -409,29 +434,52 @@ def flash_attention(
     causal: bool = True,
     bias=None,
     sm_scale: float | None = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ):
     """Fused blockwise attention. q/k/v: [B, S, H, D] -> [B, S, H, D].
 
     ``bias`` (e.g. alibi) is not fused; callers needing additive bias use the
     XLA path (models/transformer._attention_dispatch falls back).
+
+    Sequence lengths need not be block-aligned when ``causal``: q/k/v are
+    zero-padded up to a 128 multiple — padded key positions sit *after* every
+    real query position, so the causal mask already excludes them, and padded
+    query rows are sliced off the output (curriculum-truncated odd lengths
+    train fine under attn_impl='flash').
     """
     if bias is not None:
         raise NotImplementedError("flash_attention: additive bias not fused; use attn_impl='xla'")
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
-    block_q = min(block_q, Sq)
-    block_k = min(block_k, Sk)
-    if Sq % block_q or Sk % block_k:
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(D)
+
+    pad_q = (-Sq) % 128
+    pad_k = (-Sk) % 128
+    if pad_q or pad_k:
+        if not causal:
+            raise ValueError(
+                f"non-causal flash_attention needs 128-aligned lengths, got ({Sq}, {Sk})"
+            )
+        if Sq == Sk:  # keep self-attention's diagonal alignment
+            q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+            k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        else:
+            raise ValueError(
+                f"cross-attention lengths ({Sq}, {Sk}) must be 128-aligned"
+            )
+    Sq_p, Sk_p = q.shape[1], k.shape[1]
+    block_q = min(block_q, Sq_p) if block_q else _auto_block(Sq_p, MAX_BLOCK_Q)
+    block_k = min(block_k, Sk_p) if block_k else _auto_block(Sk_p, MAX_BLOCK_K)
+    if Sq_p % block_q or Sk_p % block_k:
         raise ValueError(
-            f"sequence lengths ({Sq}, {Sk}) must be divisible by blocks ({block_q}, {block_k})"
+            f"sequence lengths ({Sq_p}, {Sk_p}) must be divisible by blocks ({block_q}, {block_k})"
         )
     if interpret is None:
         interpret = _interpret_default()
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(D)
 
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(x.shape[0] * x.shape[2], x.shape[1], x.shape[3])
@@ -439,4 +487,7 @@ def flash_attention(
     out = _flash_bhsd(
         to_bhsd(q), to_bhsd(k), to_bhsd(v), sm_scale, causal, block_q, block_k, interpret
     )
-    return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    out = out.reshape(B, H, Sq_p, D).transpose(0, 2, 1, 3)
+    if pad_q:
+        out = out[:, :Sq]
+    return out

@@ -103,7 +103,6 @@ class DeepSpeedEngine:
         self.train_batch_size = self.config.train_batch_size
         self.global_steps = 0
         self.global_samples = 0
-        self.skipped_steps = 0
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size, steps_per_output=self.config.steps_per_print
@@ -138,6 +137,30 @@ class DeepSpeedEngine:
         )
         self.batch_spec = batch_spec if batch_spec is not None else PartitionSpec(("data", "fsdp"), "context")
 
+        # ---- ZeRO-Offload (reference: runtime/zero/parameter_offload.py:175 +
+        # csrc/adam/cpu_adam.cpp host Adam). TPU-native: master fp32 params +
+        # optimizer moments live in HOST memory (pinned_host memory kind);
+        # the optimizer update is compiled into the train step as a
+        # compute_on('device_host') region, so XLA schedules the d2h grad
+        # stream, the host-side update, and the h2d bf16 param copy-back —
+        # the role the reference's cpu_adam kernel + custom CUDA copy play.
+        off_opt = self.config.zero_optimization.offload_optimizer
+        self.offload_optimizer_enabled = off_opt.device in ("cpu", "nvme")
+        if off_opt.device == "nvme":
+            logger.warning("offload_optimizer device 'nvme' tiers to host memory on TPU-VM")
+        off_param = self.config.zero_optimization.offload_param
+        if off_param.device != "none":
+            raise NotImplementedError(
+                "offload_param is not supported: ZeRO-3 param sharding over the "
+                "fsdp axis covers the param-memory budget on TPU; set "
+                "offload_param.device='none'"
+            )
+        # memory-kind I/O through jit is TPU-only; on the CPU test backend the
+        # same compute_on('device_host') path runs with device-memory state.
+        self._host_memory_kind = (
+            "pinned_host" if (self.offload_optimizer_enabled and jax.devices()[0].platform == "tpu") else None
+        )
+
         # ---- optimizer -------------------------------------------------------
         opt_cfg = self.config.optimizer
         self.opt_init, self.opt_update, base_lr = get_optimizer(opt_cfg.type, opt_cfg.params)
@@ -158,7 +181,7 @@ class DeepSpeedEngine:
         # Optimizer state lives on the ZeRO shards: mirror opt specs per leaf.
         opt_state_shape = jax.eval_shape(self.opt_init, shapes)
         self.opt_specs = self._mirror_opt_specs(opt_state_shape)
-        opt_shardings = shd.tree_shardings(self.mesh, self.opt_specs)
+        opt_shardings = self._to_host_shardings(shd.tree_shardings(self.mesh, self.opt_specs))
         opt_state = jax.jit(self.opt_init, out_shardings=opt_shardings)(params)
 
         fp16 = self.config.fp16
@@ -170,6 +193,7 @@ class DeepSpeedEngine:
             "opt": opt_state,
             "loss_scale": jnp.asarray(scale0 if fp16.enabled else 1.0, jnp.float32),
             "good_steps": jnp.zeros((), jnp.int32),
+            "skipped": jnp.zeros((), jnp.int32),
         }
         self._state_shardings = {
             "step": dist.replicated(self.mesh),
@@ -177,7 +201,25 @@ class DeepSpeedEngine:
             "opt": opt_shardings,
             "loss_scale": dist.replicated(self.mesh),
             "good_steps": dist.replicated(self.mesh),
+            "skipped": dist.replicated(self.mesh),
         }
+        if self.offload_optimizer_enabled:
+            # master fp32 weights move to host alongside the moments; the
+            # device keeps only the compute-dtype (bf16/fp16) working copy.
+            master_shardings = self._to_host_shardings(
+                shd.tree_shardings(self.mesh, self.opt_specs_for_params)
+            )
+            cdt = self.config.compute_dtype
+            master = jax.jit(lambda p: p, out_shardings=master_shardings)(self.state["params"])
+            params16 = jax.jit(
+                lambda p: jax.tree.map(
+                    lambda x: x.astype(cdt) if x.dtype == jnp.float32 else x, p
+                ),
+                out_shardings=param_shardings,
+            )(self.state["params"])
+            self.state["params"] = params16
+            self.state["master"] = master
+            self._state_shardings["master"] = master_shardings
 
         # curriculum learning (reference engine hook: engine.py:1636-1642)
         self.curriculum_scheduler = None
@@ -202,6 +244,18 @@ class DeepSpeedEngine:
         )
 
     # ------------------------------------------------------------------
+    def _to_host_shardings(self, shardings):
+        """Retarget a sharding tree to host memory when the optimizer is
+        offloaded (no-op otherwise / on backends without memory kinds)."""
+        if not self._host_memory_kind:
+            return shardings
+        return jax.tree.map(
+            lambda s: s.with_memory_kind(self._host_memory_kind),
+            shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding),
+        )
+
+    # ------------------------------------------------------------------
     def _mirror_opt_specs(self, opt_state_shape):
         """Optimizer states in ops/optimizers.py are dicts of param-shaped
         trees ({'m': <like params>, 'v': ...}); give each such sub-tree the
@@ -219,6 +273,54 @@ class DeepSpeedEngine:
         return out
 
     # ------------------------------------------------------------------
+    def _make_apply_update(self):
+        """Optimizer-apply stage, shared by the fused train step and the
+        3-call compat path. Returns apply_update(state, grads, finite, step1,
+        lr) -> (new_params, new_opt, extras).
+
+        Offload mode compiles the update as a compute_on('device_host')
+        region over the host-resident master/moments (the reference's
+        cpu_adam host kernel, csrc/adam/cpu_adam.cpp:284, as a compiled
+        region instead of a pybind call)."""
+        mesh, param_specs = self.mesh, self.param_specs
+        compute_dtype = self.config.compute_dtype
+        opt_update = self.opt_update
+
+        if not self.offload_optimizer_enabled:
+
+            def apply_update(state, grads, finite, step1, lr):
+                new_params, new_opt = opt_update(grads, state["opt"], state["params"], step1, lr)
+                new_params = shd.constrain(new_params, mesh, param_specs)
+                new_params = _tree_where(finite, new_params, state["params"])
+                new_opt = _tree_where(finite, new_opt, state["opt"])
+                return new_params, new_opt, {}
+
+            return apply_update
+
+        from jax.experimental.compute_on import compute_on
+
+        def host_update(grads, opt, master, finite, step1, lr):
+            new_master, new_opt = opt_update(grads, opt, master, step1, lr)
+            new_master = _tree_where(finite, new_master, master)
+            new_opt = _tree_where(finite, new_opt, opt)
+            p16 = jax.tree.map(
+                lambda x: x.astype(compute_dtype) if x.dtype == jnp.float32 else x,
+                new_master,
+            )
+            return new_master, new_opt, p16
+
+        host_update = compute_on("device_host")(jax.jit(host_update))
+
+        def apply_update(state, grads, finite, step1, lr):
+            new_master, new_opt, p16 = host_update(
+                grads, state["opt"], state["master"], finite, step1, lr
+            )
+            p16 = shd.constrain(p16, mesh, param_specs)
+            return p16, new_opt, {"master": new_master}
+
+        return apply_update
+
+    # ------------------------------------------------------------------
     # Fused train step
     # ------------------------------------------------------------------
     def _build_train_step(self):
@@ -232,6 +334,7 @@ class DeepSpeedEngine:
         param_specs = self.param_specs
         grad_specs = self.opt_specs_for_params if self.zero_stage >= 2 else self.param_specs
         batch_spec = self.batch_spec
+        apply_update = self._make_apply_update()
 
         def loss_fn(params, mb, loss_scale):
             cast = jax.tree.map(lambda p: p.astype(compute_dtype) if p.dtype == jnp.float32 else p, params)
@@ -278,8 +381,7 @@ class DeepSpeedEngine:
 
             step1 = state["step"] + 1
             lr = self.lr_schedule(step1)
-            new_params, new_opt = self.opt_update(grads, state["opt"], params, step1, lr)
-            new_params = shd.constrain(new_params, mesh, param_specs)
+            new_params, new_opt, extras = apply_update(state, grads, finite, step1, lr)
 
             # fp16 dynamic loss scaling (reference: runtime/fp16/loss_scaler.py
             # DynamicLossScaler): halve + skip on overflow, double every
@@ -299,10 +401,12 @@ class DeepSpeedEngine:
 
             new_state = {
                 "step": jnp.where(finite, step1, state["step"]),
-                "params": _tree_where(finite, new_params, params),
-                "opt": _tree_where(finite, new_opt, state["opt"]),
+                "params": new_params,
+                "opt": new_opt,
                 "loss_scale": new_scale,
                 "good_steps": good,
+                "skipped": state["skipped"] + (~finite).astype(jnp.int32),
+                **extras,
             }
             metrics = {
                 "loss": loss,
@@ -326,6 +430,12 @@ class DeepSpeedEngine:
         """Run one full (micro × gas) training step; returns metrics dict.
 
         ``batch`` leaves must be [train_batch_size, ...] host or device arrays.
+
+        Metrics stay ON DEVICE unless this step needs them on host (print
+        boundary / monitor enabled). A synchronous per-step device_get costs
+        multiple host<->device round-trips and was measured to dominate step
+        time 5:1 on a tunneled chip (experiments/perf_probe4.py) — steps chain
+        asynchronously instead, and overflow accounting catches up lazily.
         """
         if self._train_step is None:
             self._train_step = self._build_train_step()
@@ -333,20 +443,22 @@ class DeepSpeedEngine:
             batch = self._apply_curriculum(batch)
         self.tput_timer.start()
         self.state, metrics = self._train_step(self.state, batch)
-        metrics = jax.device_get(metrics)
         self.tput_timer.stop()
         self.global_steps += 1
         self.global_samples += self.train_batch_size
-        if bool(metrics["overflow"]):
-            self.skipped_steps += 1
-        if self.global_steps % self.config.steps_per_print == 0:
-            self._report_progress(metrics)
-        self.monitor.write_events(
-            [
-                ("Train/Samples/train_loss", float(metrics["loss"]), self.global_samples),
-                ("Train/Samples/lr", float(metrics["lr"]), self.global_samples),
-            ]
+        need_host = (
+            self.global_steps % self.config.steps_per_print == 0 or self.monitor.enabled
         )
+        if need_host:
+            metrics = jax.device_get(metrics)
+            if self.global_steps % self.config.steps_per_print == 0:
+                self._report_progress(metrics)
+            self.monitor.write_events(
+                [
+                    ("Train/Samples/train_loss", float(metrics["loss"]), self.global_samples),
+                    ("Train/Samples/lr", float(metrics["lr"]), self.global_samples),
+                ]
+            )
         return metrics
 
     def _apply_curriculum(self, batch: dict) -> dict:
@@ -426,9 +538,16 @@ class DeepSpeedEngine:
                 return model.loss(cast, batch) * state["loss_scale"]
 
             g = jax.grad(f)(state["params"])
+            # offload mode stores params in compute dtype, so grads come back
+            # bf16 — upcast before the caller's cross-micro accumulation so
+            # small contributions aren't rounded away (fused path accumulates
+            # into fp32 zeros already)
+            g = jax.tree.map(lambda x: x.astype(jnp.float32), g)
             return shd.constrain(g, mesh, grad_specs)
 
         self._grad_fn = jax.jit(grad_of)
+
+        apply_update = self._make_apply_update()
 
         def apply_of(state, grads, n_micro):
             clip = self.config.gradient_clipping
@@ -442,8 +561,7 @@ class DeepSpeedEngine:
                 grads = _tree_scale(grads, jnp.minimum(1.0, clip / (gnorm + 1e-6)))
             step1 = state["step"] + 1
             lr = self.lr_schedule(step1)
-            new_params, new_opt = self.opt_update(grads, state["opt"], state["params"], step1, lr)
-            new_params = shd.constrain(new_params, mesh, self.param_specs)
+            new_params, new_opt, extras = apply_update(state, grads, finite, step1, lr)
             fp16 = self.config.fp16
             if self.fp16_enabled and fp16.loss_scale == 0:
                 good = jnp.where(finite, state["good_steps"] + 1, 0)
@@ -458,10 +576,12 @@ class DeepSpeedEngine:
                 good, new_scale = state["good_steps"], state["loss_scale"]
             return {
                 "step": jnp.where(finite, step1, state["step"]),
-                "params": _tree_where(finite, new_params, state["params"]),
-                "opt": _tree_where(finite, new_opt, state["opt"]),
+                "params": new_params,
+                "opt": new_opt,
                 "loss_scale": new_scale,
                 "good_steps": good,
+                "skipped": state["skipped"] + (~finite).astype(jnp.int32),
+                **extras,
             }, ~finite
 
         self._apply_fn = jax.jit(apply_of, donate_argnums=(0, 1), static_argnums=(2,))
@@ -484,8 +604,6 @@ class DeepSpeedEngine:
         self._accum_grads = None
         self._micro_count = 0
         self.global_steps += 1
-        if bool(jax.device_get(overflow)):
-            self.skipped_steps += 1
 
     # ------------------------------------------------------------------
     def eval_batch(self, batch: dict):
@@ -504,6 +622,12 @@ class DeepSpeedEngine:
     @property
     def loss_scale(self) -> float:
         return float(jax.device_get(self.state["loss_scale"]))
+
+    @property
+    def skipped_steps(self) -> int:
+        """Overflow-skipped step count. Lives in the compiled state (train
+        steps never sync on it); reading this property fetches from device."""
+        return int(jax.device_get(self.state["skipped"]))
 
     # ------------------------------------------------------------------
     # Checkpointing (reference: engine.py:2877 save / :2527 load)
@@ -539,5 +663,4 @@ class DeepSpeedEngine:
         self.state = state
         self.global_steps = client_state.get("global_steps", int(jax.device_get(state["step"])))
         self.global_samples = client_state.get("global_samples", 0)
-        self.skipped_steps = client_state.get("skipped_steps", 0)
         return tag, client_state

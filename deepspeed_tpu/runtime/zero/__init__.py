@@ -1,0 +1,8 @@
+from .memory_estimators import (  # noqa: F401
+    MemoryEstimate,
+    estimate_from_model,
+    estimate_zero1_model_states_mem_needs,
+    estimate_zero2_model_states_mem_needs,
+    estimate_zero3_model_states_mem_needs,
+    print_mem_estimates,
+)

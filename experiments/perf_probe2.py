@@ -1,0 +1,76 @@
+"""Probe 2: chip matmul peak, FFN-shaped matmuls, flash block-size sweep."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    leaf = jax.tree.leaves(out)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+
+
+def timeit(fn, *args, n=3, warmup=1):
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / n
+
+
+def rep(op, reps, *shapes):
+    def f(*xs):
+        def body(carry, _):
+            out = op(*( [xs[0] + carry] + list(xs[1:]) ))
+            return out.ravel()[0].astype(xs[0].dtype) * 1e-9, None
+        carry, _ = jax.lax.scan(body, jnp.zeros((), xs[0].dtype), None, length=reps)
+        return carry
+    return jax.jit(f)
+
+
+def matmul_peak():
+    rng = jax.random.PRNGKey(0)
+    for M, K, N in [(8192, 8192, 8192), (65536, 768, 3072), (65536, 768, 768), (65536, 768, 50304)]:
+        a = jax.random.normal(rng, (M, K), jnp.bfloat16)
+        b = jax.random.normal(rng, (K, N), jnp.bfloat16)
+        op = lambda a, b: jnp.dot(a, b)
+        reps = max(1, int(2e12 / (2 * M * K * N)))
+        t = timeit(rep(op, reps), a, b) / reps
+        fl = 2 * M * K * N
+        print(f"matmul {M}x{K}x{N}: {t*1e3:.2f} ms ({fl/t/1e12:.1f} TFLOPS)")
+
+
+def flash_sweep(B=64, S=1024, H=12, D=64):
+    import sys
+    sys.path.insert(0, "/root/repo")
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = jax.random.PRNGKey(0)
+    q = jax.random.normal(rng, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(rng, (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(rng, (B, S, H, D), jnp.bfloat16)
+    fwd_flops = 4 * B * H * S * S * D / 2
+
+    for bq, bk in [(128, 128), (256, 256), (512, 512), (512, 1024), (1024, 1024), (256, 1024)]:
+        if bq > S or bk > S:
+            continue
+        try:
+            op = lambda q, k, v: flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+            t = timeit(rep(op, 10), q, k, v) / 10
+            print(f"flash fwd bq={bq} bk={bk}: {t*1e3:.2f} ms ({fwd_flops/t/1e12:.1f} TFLOPS)")
+            gop = jax.grad(lambda q, k, v: jnp.sum(op(q, k, v).astype(jnp.float32)))
+            t = timeit(rep(gop, 10), q, k, v) / 10
+            print(f"flash f+b bq={bq} bk={bk}: {t*1e3:.2f} ms ({3.5*fwd_flops/t/1e12:.1f} TFLOPS)")
+        except Exception as e:
+            print(f"flash bq={bq} bk={bk} FAILED: {str(e)[:150]}")
+
+
+if __name__ == "__main__":
+    print(f"devices: {jax.devices()}")
+    matmul_peak()
+    flash_sweep()

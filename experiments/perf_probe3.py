@@ -1,0 +1,118 @@
+"""Probe 3: bisect the train step — embed scatter, fwd, bwd, optimizer."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+L, H, D, V, S, B = 12, 12, 768, 50304, 1024, 64
+
+
+def _sync(out):
+    leaf = jax.tree.leaves(out)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+
+
+def timeit(fn, *args, n=5, warmup=1):
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / n
+
+
+def scatter_probe():
+    rng = jax.random.PRNGKey(0)
+    wte = jax.random.normal(rng, (V, D), jnp.float32)
+    tokens = jax.random.randint(rng, (B, S), 0, V)
+    c = jax.random.normal(rng, (B, S, D), jnp.bfloat16)
+
+    def f(wte):
+        return jnp.sum(wte[tokens].astype(jnp.bfloat16) * c).astype(jnp.float32)
+
+    g = jax.jit(jax.grad(f))
+    t = timeit(g, wte)
+    print(f"embed gather+scatter-add grad: {t*1e3:.1f} ms")
+
+    # one-hot matmul alternative
+    def f2(wte):
+        oh = jax.nn.one_hot(tokens, V, dtype=jnp.bfloat16)
+        emb = jnp.einsum("bsv,vd->bsd", oh, wte.astype(jnp.bfloat16))
+        return jnp.sum(emb * c).astype(jnp.float32)
+
+    g2 = jax.jit(jax.grad(f2))
+    t = timeit(g2, wte)
+    print(f"embed one-hot matmul grad:     {t*1e3:.1f} ms")
+
+
+def model_bisect(policy="save_flash"):
+    cfg = TransformerConfig(
+        vocab_size=V, max_seq_len=S, num_layers=L, num_heads=H, hidden_size=D,
+        pos_emb="learned", dtype=jnp.bfloat16, remat=True, remat_policy=policy,
+        attn_impl="flash", loss_chunk_size=512,
+    )
+    model = Model(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, V, size=(B, S + 1)).astype(np.int32))
+    batch = {"tokens": tokens}
+
+    def loss_of(params, batch):
+        cast = jax.tree.map(lambda p: p.astype(jnp.bfloat16) if p.dtype == jnp.float32 else p, params)
+        return model.loss(cast, batch)
+
+    t = timeit(jax.jit(loss_of), params, batch)
+    print(f"fwd-only ({policy}): {t*1e3:.0f} ms")
+    t = timeit(jax.jit(jax.grad(loss_of)), params, batch)
+    print(f"fwd+bwd  ({policy}): {t*1e3:.0f} ms")
+
+    # hidden-only model (no vocab loss): isolate the lm-head/loss cost
+    def hidden_of(params, batch):
+        cast = jax.tree.map(lambda p: p.astype(jnp.bfloat16) if p.dtype == jnp.float32 else p, params)
+        from deepspeed_tpu.models import transformer as T
+        h = T.apply(cfg, cast, batch["tokens"][:, :-1], return_hidden=True)
+        return jnp.sum(h.astype(jnp.float32) * 1e-6)
+
+    t = timeit(jax.jit(hidden_of), params, batch)
+    print(f"fwd hidden-only: {t*1e3:.0f} ms")
+    t = timeit(jax.jit(jax.grad(hidden_of)), params, batch)
+    print(f"f+b hidden-only: {t*1e3:.0f} ms")
+
+
+def optimizer_probe():
+    from deepspeed_tpu.ops.optimizers import get_optimizer
+    cfg = TransformerConfig(
+        vocab_size=V, max_seq_len=S, num_layers=L, num_heads=H, hidden_size=D,
+        pos_emb="learned", dtype=jnp.bfloat16)
+    model = Model(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    oinit, oupd, lr = get_optimizer("AdamW", {"lr": 6e-4, "weight_decay": 0.1})
+    opt = jax.jit(oinit)(params)
+    grads = jax.tree.map(lambda p: jnp.ones_like(p, jnp.float32), params)
+
+    def step(grads, opt, params):
+        return oupd(grads, opt, params, jnp.ones((), jnp.int32), 6e-4)
+
+    t = timeit(jax.jit(step), grads, opt, params)
+    print(f"optimizer update: {t*1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    import sys
+    which = sys.argv[1:] or ["scatter", "opt", "bisect"]
+    for w in which:
+        if w == "scatter":
+            scatter_probe()
+        elif w == "opt":
+            optimizer_probe()
+        elif w == "bisect":
+            model_bisect()
+        elif w == "bisect_dots":
+            model_bisect("dots_and_flash")

@@ -1,0 +1,78 @@
+"""Probe 4: dispatch/fetch RTT vs raw compiled train-step time."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+L, H, D, V, S, B = 12, 12, 768, 50304, 1024, 64
+
+
+def rtt_probe():
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8, 128))
+    _ = np.asarray(jax.device_get(f(x).ravel()[0]))
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        _ = np.asarray(jax.device_get(f(x).ravel()[0]))
+    print(f"dispatch+scalar-fetch RTT: {(time.perf_counter()-t0)/n*1e3:.1f} ms")
+
+
+def raw_step_probe():
+    cfg = TransformerConfig(
+        vocab_size=V, max_seq_len=S, num_layers=L, num_heads=H, hidden_size=D,
+        pos_emb="learned", dtype=jnp.bfloat16, remat=True, remat_policy="save_flash",
+        attn_impl="flash", loss_chunk_size=512,
+    )
+    model = Model(cfg)
+    ds_cfg = {
+        "train_batch_size": B,
+        "train_micro_batch_size_per_gpu": B,
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000000,
+        "mesh": {"data": -1},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_cfg)
+    tokens = np.random.default_rng(0).integers(0, V, size=(B, S + 1)).astype(np.int32)
+    batch = {"tokens": tokens}
+    step = engine._train_step = engine._build_train_step()
+    state, metrics = step(engine.state, batch)  # compile
+    _ = np.asarray(jax.device_get(metrics["loss"]))
+    tok = B * S
+    n_params = L * 12 * D * D + V * D + S * D
+    fpt = 6 * n_params + L * 12 * S * D
+    dbatch = jax.device_put(batch)
+    n = 10
+
+    def measure(name, use_batch, fetch):
+        nonlocal state
+        for _ in range(3):  # warmup
+            state, metrics = step(state, use_batch)
+        _ = np.asarray(jax.device_get(metrics["loss"]))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, metrics = step(state, use_batch)
+            if fetch:
+                _ = jax.device_get(metrics)
+        if not fetch:
+            _ = np.asarray(jax.device_get(metrics["loss"]))
+        dt = (time.perf_counter() - t0) / n
+        print(f"{name}: {dt*1e3:.0f} ms/step  {tok/dt:,.0f} tok/s  {tok/dt*fpt/1e12:.1f} TFLOPS")
+
+    measure("raw step host-batch sync-at-end", batch, False)
+    measure("raw step device-batch sync-at-end", dbatch, False)
+    measure("step device-batch per-step metrics", dbatch, True)
+    measure("raw step host-batch sync-at-end (2nd)", batch, False)
+
+
+if __name__ == "__main__":
+    rtt_probe()
+    raw_step_probe()

@@ -1,0 +1,85 @@
+"""Verify driver: end-to-end flows on the 8-device virtual CPU mesh."""
+
+import os
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys
+
+sys.path.insert(0, "/root/repo")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+ok = []
+
+# --- training: loss decreases (incl. new save_flash remat default off) -----
+cfg = TransformerConfig(
+    vocab_size=211, max_seq_len=64, num_layers=2, num_heads=4, hidden_size=32,
+    dtype=jnp.float32, loss_chunk_size=0,
+)
+ds_cfg = {
+    "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+    "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+    "zero_optimization": {"stage": 2}, "bf16": {"enabled": False},
+    "gradient_clipping": 1.0, "steps_per_print": 10**9, "mesh": {"data": -1},
+}
+engine, _, _, _ = deepspeed_tpu.initialize(model=Model(cfg), config=ds_cfg)
+batch = {"tokens": np.random.default_rng(0).integers(0, 211, size=(8, 65)).astype(np.int32)}
+losses = [float(jax.device_get(engine.train_batch(batch)["loss"])) for _ in range(8)]
+assert losses[-1] < losses[0] - 0.2, f"loss not decreasing: {losses}"
+ok.append(f"train loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+
+# --- offload engine trains too ---------------------------------------------
+ds_off = dict(ds_cfg)
+ds_off["zero_optimization"] = {"stage": 2, "offload_optimizer": {"device": "cpu"}}
+e_off, _, _, _ = deepspeed_tpu.initialize(model=Model(cfg), config=ds_off)
+l0 = float(jax.device_get(e_off.train_batch(batch)["loss"]))
+for _ in range(5):
+    m = e_off.train_batch(batch)
+l1 = float(jax.device_get(m["loss"]))
+assert l1 < l0, f"offload loss not decreasing {l0} -> {l1}"
+ok.append(f"offload train loss {l0:.3f} -> {l1:.3f}")
+
+# --- checkpoint round trip --------------------------------------------------
+import tempfile
+
+with tempfile.TemporaryDirectory() as d:
+    engine.save_checkpoint(d)
+    before = np.asarray(jax.device_get(engine.state["params"]["wte"]))
+    engine.state["params"]["wte"] = engine.state["params"]["wte"] * 0 + 1.0
+    engine.load_checkpoint(d)
+    after = np.asarray(jax.device_get(engine.state["params"]["wte"]))
+    np.testing.assert_allclose(before, after)
+ok.append("checkpoint round-trip")
+
+# --- inference generate with new decode kernel + sampling -------------------
+from deepspeed_tpu.inference.engine import InferenceEngine
+
+eng = InferenceEngine(model=Model(cfg), config={"dtype": "fp32"})
+prompt = np.random.default_rng(1).integers(0, 211, size=(2, 7)).astype(np.int32)
+out_greedy = eng.generate(prompt, max_new_tokens=5, temperature=0.0)
+out_sampled = eng.generate(prompt, max_new_tokens=5, temperature=0.9, top_k=30, top_p=0.9,
+                           repetition_penalty=1.3)
+assert out_greedy.shape == (2, 5) and out_sampled.shape == (2, 5)
+ok.append("generate greedy+sampled (decode kernel)")
+
+# --- flash attention padding path on odd length -----------------------------
+cfg_f = cfg.replace(attn_impl="flash", max_seq_len=200)
+from deepspeed_tpu.models import transformer as tfm
+
+params = tfm.init(cfg_f, jax.random.PRNGKey(0))
+toks = jnp.asarray(np.random.default_rng(2).integers(0, 211, size=(2, 200)), jnp.int32)
+lf = tfm.apply(cfg_f, params, toks)
+lx = tfm.apply(cfg_f.replace(attn_impl="xla"), params, toks)
+np.testing.assert_allclose(np.asarray(lf), np.asarray(lx), rtol=5e-3, atol=5e-3)
+ok.append("flash odd-length padding matches xla")
+
+print("VERIFY OK:")
+for line in ok:
+    print(" -", line)

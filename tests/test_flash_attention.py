@@ -65,10 +65,14 @@ def test_gradients_match_xla():
         )
 
 
-def test_bad_seq_len_raises():
+def test_unaligned_seq_len_pads():
+    # curriculum-truncated odd lengths (VERDICT r02 weak #10): causal padding
+    # path — padded keys are causally masked, padded query rows sliced off
     q, k, v = _qkv(S=200)
-    with pytest.raises(ValueError, match="divisible"):
-        flash_attention(q, k, v, block_q=128, block_k=128)
+    out = flash_attention(q, k, v, block_q=128, block_k=128)
+    ref = xla_attention(q, k, v)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-3)
 
 
 def test_bias_not_supported():

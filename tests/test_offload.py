@@ -1,0 +1,115 @@
+"""ZeRO-Offload: host-tiered optimizer state (VERDICT r02 ask #2).
+
+Reference behavior being matched: runtime/zero/parameter_offload.py:175 +
+csrc/adam/cpu_adam.cpp:284 — master fp32 weights + Adam moments live off-HBM
+and the update runs on the host; the device keeps a compute-dtype copy.
+On the CPU test backend memory kinds are unavailable for jit I/O, so these
+tests exercise the compute_on('device_host') code path and state layout; the
+pinned_host placement itself is asserted structurally.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+from deepspeed_tpu.runtime.zero import (
+    estimate_zero2_model_states_mem_needs,
+    estimate_zero3_model_states_mem_needs,
+)
+
+
+def _cfg(offload: bool, stage: int = 2):
+    zero = {"stage": stage}
+    if offload:
+        zero["offload_optimizer"] = {"device": "cpu"}
+    return {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "zero_optimization": zero,
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10**9,
+        "mesh": {"data": -1},
+    }
+
+
+def _engine(offload: bool, stage: int = 2):
+    cfg = TransformerConfig(
+        vocab_size=128, max_seq_len=64, num_layers=2, num_heads=2, hidden_size=32,
+        dtype=jnp.bfloat16, loss_chunk_size=0,
+    )
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=Model(cfg), config=_cfg(offload, stage)
+    )
+    return engine
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"tokens": rng.integers(0, 128, size=(8, 65)).astype(np.int32)}
+
+
+def test_offload_state_layout():
+    e = _engine(offload=True)
+    assert e.offload_optimizer_enabled
+    # device params are compute-dtype; master fp32 exists
+    assert e.state["params"]["wte"].dtype == jnp.bfloat16
+    assert e.state["master"]["wte"].dtype == jnp.float32
+    # moments exist per leaf
+    assert e.state["opt"]["m"]["wte"].shape == e.state["master"]["wte"].shape
+    # on CPU test backend memory kind stays default; the TPU branch requests
+    # pinned_host (gate is platform-based)
+    assert e._host_memory_kind is None  # cpu backend
+
+
+def test_offload_trains_and_matches_unoffloaded():
+    b = _batch()
+    e_off = _engine(offload=True)
+    e_ref = _engine(offload=False)
+    losses_off, losses_ref = [], []
+    for i in range(4):
+        losses_off.append(float(jax.device_get(e_off.train_batch(b)["loss"])))
+        losses_ref.append(float(jax.device_get(e_ref.train_batch(b)["loss"])))
+    # same inits + same data => identical trajectories (both do the fp32
+    # master update; offload only moves where it runs)
+    np.testing.assert_allclose(losses_off, losses_ref, rtol=2e-2)
+    assert losses_off[-1] < losses_off[0]
+    # master stayed fp32 and moved: device bf16 copy mirrors it
+    m = jax.device_get(e_off.state["master"]["wte"])
+    p = jax.device_get(e_off.state["params"]["wte"])
+    np.testing.assert_allclose(m.astype(np.float32), p.astype(np.float32), atol=1e-2)
+
+
+def test_offload_zero3_composes():
+    e = _engine(offload=True, stage=3)
+    m = e.train_batch(_batch())
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
+def test_offload_param_rejected():
+    cfg = _cfg(False)
+    cfg["zero_optimization"]["offload_param"] = {"device": "cpu"}
+    tcfg = TransformerConfig(
+        vocab_size=128, max_seq_len=64, num_layers=2, num_heads=2, hidden_size=32,
+        dtype=jnp.bfloat16, loss_chunk_size=0,
+    )
+    with pytest.raises(NotImplementedError, match="offload_param"):
+        deepspeed_tpu.initialize(model=Model(tcfg), config=cfg)
+
+
+def test_memory_estimators():
+    P = 1_000_000_000  # 1B params
+    e = estimate_zero2_model_states_mem_needs(P, num_chips=8)
+    # stage2: 4P params + (8P opt + 4P grads)/8
+    assert e.per_chip_hbm == 4 * P + 12 * P // 8
+    assert e.per_host_dram == 0
+    e = estimate_zero2_model_states_mem_needs(P, num_chips=8, offload_optimizer=True)
+    # offload: 2P bf16 params + 4P/8 grads on chip; 12P on host
+    assert e.per_chip_hbm == 2 * P + 4 * P // 8
+    assert e.per_host_dram == 12 * P
+    e = estimate_zero3_model_states_mem_needs(P, num_chips=8)
+    assert e.per_chip_hbm == 16 * P // 8
