@@ -1,0 +1,129 @@
+"""Generative-inference latency harness: p50/p90 per-token decode latency.
+
+The reference's inference north-star is DS-Inference p50 latency (BASELINE.md:
+2.3x lower vs PyTorch at MP=4, docs/_posts/2021-05-05-inference-kernel-
+optimization.md). This harness measures, on the current backend:
+
+  * prefill latency (one compiled call over the prompt)
+  * per-token decode latency p50/p90 — each decode step dispatched separately
+    so the distribution is observable (generation normally runs as one fused
+    scan; that path is strictly faster)
+
+Usage:  python benchmarks/inference_latency.py [--model gpt2|bloom7b-class]
+                                               [--batch 1] [--prompt 128]
+                                               [--tokens 64]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(x):
+    leaf = jax.tree.leaves(x)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+
+
+MODELS = {
+    # flagship bench model
+    "gpt2": dict(vocab_size=50304, num_layers=12, num_heads=12, hidden_size=768,
+                 max_seq_len=1024, pos_emb="learned"),
+    # BLOOM-7B-class geometry (alibi): 30L x 4096h x 32 heads
+    "bloom7b-class": dict(vocab_size=250880, num_layers=30, num_heads=32,
+                          hidden_size=4096, max_seq_len=2048, pos_emb="alibi"),
+    # small CPU smoke model
+    "smoke": dict(vocab_size=1024, num_layers=2, num_heads=4, hidden_size=64,
+                  max_seq_len=256, pos_emb="rotary"),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None, choices=list(MODELS))
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--prompt", type=int, default=128)
+    ap.add_argument("--tokens", type=int, default=64)
+    ap.add_argument("--decode-attn", default="kernel", choices=["kernel", "xla"])
+    args = ap.parse_args()
+
+    on_tpu = jax.default_backend() == "tpu"
+    name = args.model or ("gpt2" if on_tpu else "smoke")
+    if not on_tpu and name != "smoke":
+        print(f"[warn] {name} on CPU will be slow", flush=True)
+
+    import sys, os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+    spec = MODELS[name]
+    prompt_len = min(args.prompt, spec["max_seq_len"] // 2)
+    cfg = TransformerConfig(
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        decode_attn=args.decode_attn,
+        **spec,
+    )
+    model = Model(cfg)
+    eng = InferenceEngine(model=model, config={"dtype": "bf16" if on_tpu else "fp32"})
+
+    B = args.batch
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, spec["vocab_size"], size=(B, prompt_len)).astype(np.int32)
+
+    from deepspeed_tpu.models import transformer as tfm
+
+    Smax = -(-(prompt_len + args.tokens) // 128) * 128
+    params = eng.params
+
+    prefill = jax.jit(
+        lambda p, t, c: tfm.apply_with_cache(cfg, p, t, c, 0, last_only=True)
+    )
+    decode = jax.jit(
+        lambda p, t, c, pos: tfm.apply_with_cache(cfg, p, t, c, pos)
+    )
+
+    cache = tfm.init_cache(cfg, B, Smax, dtype=cfg.dtype)
+    logits, cache = prefill(params, jnp.asarray(prompt), cache)  # compile
+    _sync(logits)
+    t0 = time.perf_counter()
+    logits, cache2 = prefill(params, jnp.asarray(prompt), cache)
+    _sync(logits)
+    prefill_ms = (time.perf_counter() - t0) * 1e3
+
+    tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+    logits1, cache2 = decode(params, tok, cache2, prompt_len)  # compile
+    _sync(logits1)
+
+    lat = []
+    pos = prompt_len
+    for i in range(args.tokens):
+        t0 = time.perf_counter()
+        logits1, cache2 = decode(params, tok, cache2, pos)
+        _sync(logits1)
+        lat.append((time.perf_counter() - t0) * 1e3)
+        tok = jnp.argmax(logits1[:, -1], axis=-1).astype(jnp.int32)[:, None]
+        pos += 1
+
+    lat = np.asarray(lat)
+    out = {
+        "metric": f"{name} decode latency p50 (batch {B}, prompt {prompt_len})",
+        "value": round(float(np.percentile(lat, 50)), 2),
+        "unit": "ms/token",
+        "p90_ms": round(float(np.percentile(lat, 90)), 2),
+        "prefill_ms": round(prefill_ms, 2),
+        "decode_attn": args.decode_attn,
+        "platform": jax.default_backend(),
+        "tokens_per_sec": round(1000.0 / float(np.percentile(lat, 50)) * B, 1),
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

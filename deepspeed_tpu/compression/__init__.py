@@ -1,0 +1,16 @@
+from .compress import (  # noqa: F401
+    apply_head_pruning,
+    apply_row_pruning,
+    apply_sparse_pruning,
+    init_compression,
+    redundancy_clean,
+    reduce_layers,
+)
+from .scheduler import CompressionScheduler, QuantScheduleConfig  # noqa: F401
+from .utils import (  # noqa: F401
+    QUANTIZERS,
+    AsymQuantizer,
+    BinaryQuantizer,
+    SymQuantizer,
+    TernaryQuantizer,
+)

@@ -1,0 +1,210 @@
+"""Config-driven model compression — the reference's ``init_compression`` /
+``redundancy_clean`` (compression/compress.py) re-designed for a functional
+parameter pytree.
+
+The reference swaps nn.Modules for compressed variants (basic_layer.py:134)
+that quantize/prune inside forward. Here compression is a *parameter
+transform* plus (for QAT) a fake-quant step applied by the engine: the model
+family's stacked-layer layout makes layer reduction a gather over the layer
+axis and pruning a static mask multiply — both zero-cost under jit.
+
+Config schema (DeepSpeed "compression_training" spelling, subset):
+
+  {"compression_training": {
+      "layer_reduction": {"enabled": true, "keep_number_layer": 6,
+                          "teacher_layer": [2,4,...]} ,
+      "weight_quantization": {"shared_parameters": {...}, "different_groups": {
+          "wq1": {"params": {"target_bits": 8, "quantization_type": "symmetric",
+                   "quantize_groups": 64}}}},
+      "sparse_pruning":  {"shared_parameters": {"enabled": true, "ratio": 0.5}},
+      "row_pruning":     {"shared_parameters": {"enabled": true, "ratio": 0.25}},
+      "head_pruning":    {"shared_parameters": {"enabled": true, "ratio": 0.25}},
+  }}
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import log_dist
+
+
+# ---------------------------------------------------------------------------
+# Layer reduction (compress.py student-initialization path)
+# ---------------------------------------------------------------------------
+
+def reduce_layers(cfg, params, keep_layers):
+    """Keep only ``keep_layers`` (teacher layer indices) of the stacked layer
+    pytree; returns (new_cfg, new_params). The stacked [L, ...] layout makes
+    this a single gather per leaf."""
+    idx = jnp.asarray(list(keep_layers), jnp.int32)
+
+    def take(a):
+        return a[idx] if hasattr(a, "shape") and a.shape and a.shape[0] == cfg.num_layers else a
+
+    new_params = dict(params)
+    new_params["layers"] = jax.tree.map(take, params["layers"])
+    if "moe" in params:
+        raise NotImplementedError("layer_reduction with MoE layers is unsupported")
+    new_cfg = cfg.replace(num_layers=len(list(keep_layers)))
+    return new_cfg, new_params
+
+
+# ---------------------------------------------------------------------------
+# Pruning (basic_layer.py sparse/row/head pruning as mask transforms)
+# ---------------------------------------------------------------------------
+
+def sparse_pruning_mask(w, ratio: float):
+    """Magnitude mask zeroing the smallest ``ratio`` fraction of entries."""
+    flat = jnp.abs(w).reshape(w.shape[0], -1) if w.ndim > 1 else jnp.abs(w)[None]
+    k = max(1, int(round(flat.shape[-1] * (1.0 - ratio))))
+    thresh = jax.lax.top_k(flat, k)[0][..., -1]
+    thresh = thresh.reshape((w.shape[0],) + (1,) * (w.ndim - 1)) if w.ndim > 1 else thresh[0]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def apply_sparse_pruning(params, ratio: float):
+    """Zero the smallest-magnitude fraction of every layer weight matrix."""
+    new_layers = {}
+    for k, w in params["layers"].items():
+        if k.startswith("w") and getattr(w, "ndim", 0) >= 3:
+            new_layers[k] = w * sparse_pruning_mask(w, ratio)
+        else:
+            new_layers[k] = w
+    out = dict(params)
+    out["layers"] = new_layers
+    return out
+
+
+def apply_row_pruning(params, ratio: float):
+    """Zero the lowest-norm rows of the FFN up-projection (and the matching
+    input columns of the down-projection) — reference LinearLayer_Compress
+    row pruning."""
+    wi = params["layers"]["wi"]  # [L, d, f]
+    norms = jnp.linalg.norm(wi, axis=1)  # [L, f]
+    f = wi.shape[-1]
+    k = max(1, int(round(f * (1.0 - ratio))))
+    thresh = jax.lax.top_k(norms, k)[0][..., -1:]
+    mask = (norms >= thresh).astype(wi.dtype)  # [L, f]
+    out = dict(params)
+    layers = dict(params["layers"])
+    layers["wi"] = wi * mask[:, None, :]
+    layers["wo_mlp"] = params["layers"]["wo_mlp"] * mask[:, :, None]
+    if "bi" in layers:
+        layers["bi"] = layers["bi"] * mask
+    out["layers"] = layers
+    return out
+
+
+def apply_head_pruning(params, ratio: float):
+    """Zero the lowest-norm attention heads (by output-projection norm) —
+    reference head pruning over the attention output matrix."""
+    wo = params["layers"]["wo"]  # [L, H, Dh, d]
+    norms = jnp.linalg.norm(wo.reshape(wo.shape[0], wo.shape[1], -1), axis=-1)  # [L, H]
+    H = wo.shape[1]
+    k = max(1, int(round(H * (1.0 - ratio))))
+    thresh = jax.lax.top_k(norms, k)[0][..., -1:]
+    mask = (norms >= thresh).astype(wo.dtype)  # [L, H]
+    out = dict(params)
+    layers = dict(params["layers"])
+    layers["wo"] = wo * mask[:, :, None, None]
+    out["layers"] = layers
+    return out
+
+
+# ---------------------------------------------------------------------------
+# init_compression / redundancy_clean
+# ---------------------------------------------------------------------------
+
+def _shared(block: Optional[dict]) -> dict:
+    block = block or {}
+    return block.get("shared_parameters", block)
+
+
+def _already_quantized(params) -> bool:
+    return any(
+        isinstance(v, dict) and ("q" in v or "q4" in v)
+        for v in params.get("layers", {}).values()
+    )
+
+
+def init_compression(model, params, ds_config: dict, _finalize: bool = False):
+    """Apply the enabled compression transforms; returns (model, params).
+
+    Structural transforms (layer reduction, pruning) are applied here.
+    ``weight_quantization`` at init time means *QAT*: the bit-width schedule
+    runs through the engine's quantize-training hook
+    (``scheduler.CompressionScheduler``), so params stay fp here and only
+    ``redundancy_clean`` converts them to int storage — matching the
+    reference's swap-then-clean split (compress.py init_compression vs
+    redundancy_clean). Re-running on already-transformed (model, params) is a
+    no-op for transforms that were applied.
+    """
+    from ..models.transformer import Model, quantize_weights
+
+    comp = ds_config.get("compression_training", {}) if isinstance(ds_config, dict) else {}
+    cfg = model.config
+
+    lr = comp.get("layer_reduction", {})
+    if lr.get("enabled"):
+        keep = lr.get("teacher_layer")
+        if keep is None:
+            n = int(lr["keep_number_layer"])
+            keep = list(np.linspace(0, cfg.num_layers - 1, n).round().astype(int))
+        if len(keep) == cfg.num_layers:
+            pass  # already reduced (redundancy_clean after init_compression)
+        elif max(keep) >= cfg.num_layers:
+            raise ValueError(
+                f"layer_reduction teacher_layer {keep} out of range for "
+                f"{cfg.num_layers}-layer model (already reduced?)"
+            )
+        else:
+            cfg, params = reduce_layers(cfg, params, keep)
+            log_dist(f"compression: layer reduction -> {len(keep)} layers {keep}", ranks=[0])
+
+    sp = _shared(comp.get("sparse_pruning"))
+    if sp.get("enabled"):
+        params = apply_sparse_pruning(params, float(sp.get("ratio", 0.5)))
+        log_dist(f"compression: sparse pruning ratio {sp.get('ratio', 0.5)}", ranks=[0])
+
+    rp = _shared(comp.get("row_pruning"))
+    if rp.get("enabled"):
+        params = apply_row_pruning(params, float(rp.get("ratio", 0.25)))
+        log_dist(f"compression: row pruning ratio {rp.get('ratio', 0.25)}", ranks=[0])
+
+    hp = _shared(comp.get("head_pruning"))
+    if hp.get("enabled"):
+        params = apply_head_pruning(params, float(hp.get("ratio", 0.25)))
+        log_dist(f"compression: head pruning ratio {hp.get('ratio', 0.25)}", ranks=[0])
+
+    wq = _shared(comp.get("weight_quantization"))
+    if wq.get("enabled"):
+        bits = int(wq.get("target_bits", wq.get("bits", 8)))
+        groups = int(wq.get("quantize_groups", 64))
+        if _finalize and not _already_quantized(params):
+            cfg = cfg.replace(weight_bits=bits, weight_group_size=groups)
+            params = quantize_weights(cfg, params, bits=bits, group_size=groups)
+            log_dist(f"compression: weight quantization int{bits} groups {groups}", ranks=[0])
+        elif not _finalize:
+            log_dist(
+                f"compression: weight quantization (int{bits}) scheduled as QAT — "
+                "the engine fake-quantizes during training; call "
+                "redundancy_clean after training for int storage",
+                ranks=[0],
+            )
+
+    new_model = Model(cfg, loss_fn=model._loss)
+    if model.mesh is not None:
+        new_model.set_mesh(model.mesh)
+    return new_model, params
+
+
+def redundancy_clean(model, params, ds_config: dict):
+    """Make pruning/quantization permanent (the reference's post-training
+    cleanup): re-applies hard masks and converts QAT-trained fp weights to
+    int8/int4 storage. Safe to call on an already-transformed model."""
+    return init_compression(model, params, ds_config, _finalize=True)

@@ -1,0 +1,69 @@
+"""Compression scheduler — step-gated quantization-aware training (the
+reference's compression_scheduler, compression/scheduler.py:7, and the MoQ
+quantize-during-training loop, runtime/quantize.py).
+
+Bit-width anneals from ``start_bits`` to ``target_bits``, halving every
+``quantize_period`` steps after ``schedule_offset``. The engine consults
+``bits_at(step)`` and applies a jitted fake-quant over the weight leaves when
+the bit-width changes (rare), keeping the fused train step untouched.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class QuantScheduleConfig:
+    enabled: bool = False
+    start_bits: int = 16
+    target_bits: int = 8
+    quantize_period: int = 100
+    schedule_offset: int = 0
+    quantization_type: str = "symmetric"
+    quantize_groups: int = 64
+
+    @classmethod
+    def from_ds_config(cls, raw: dict) -> "QuantScheduleConfig":
+        comp = raw.get("compression_training", {})
+        wq = comp.get("weight_quantization", {}).get("shared_parameters", {})
+        # (the reference's `quantizer_kernel` CUDA toggle is ignored on TPU)
+        if not wq.get("enabled"):
+            # also accept the MoQ spelling (reference runtime/config.py "quantize_training")
+            mq = raw.get("quantize_training", {})
+            if not mq.get("enabled"):
+                return cls()
+            return cls(
+                enabled=True,
+                start_bits=int(mq.get("quantize_bits", {}).get("start_bits", 16)),
+                target_bits=int(mq.get("quantize_bits", {}).get("target_bits", 8)),
+                quantize_period=int(mq.get("quantize_schedule", {}).get("quantize_period", 100)),
+                schedule_offset=int(mq.get("quantize_schedule", {}).get("schedule_offset", 0)),
+                quantization_type="asymmetric"
+                if mq.get("quantize_algo", {}).get("q_type") == "asymmetric"
+                else "symmetric",
+                quantize_groups=int(mq.get("quantize_groups", 64)),
+            )
+        return cls(
+            enabled=True,
+            start_bits=int(wq.get("start_bits", 16)),
+            target_bits=int(wq.get("target_bits", 8)),
+            quantize_period=int(wq.get("quantize_period", 100)),
+            schedule_offset=int(wq.get("schedule_offset", 0)),
+            quantization_type=wq.get("quantization_type", "symmetric"),
+            quantize_groups=int(wq.get("quantize_groups", 64)),
+        )
+
+
+class CompressionScheduler:
+    def __init__(self, cfg: QuantScheduleConfig):
+        self.cfg = cfg
+
+    def bits_at(self, step: int) -> int:
+        """Current fake-quant bit-width; 0 = quantization not yet active."""
+        c = self.cfg
+        if not c.enabled or step < c.schedule_offset:
+            return 0
+        halvings = (step - c.schedule_offset) // max(1, c.quantize_period)
+        bits = c.start_bits // (2**halvings) if halvings > 0 else c.start_bits
+        return max(c.target_bits, bits)

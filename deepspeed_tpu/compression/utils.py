@@ -1,0 +1,77 @@
+"""Compression quantizers — functional equivalents of the reference's
+Sym/Asym/Ternary/Binary quantizers (compression/utils.py:56-184).
+
+Each quantizer is a pure fake-quant transform (quantize → dequantize in the
+input dtype) usable inside jit for quantization-aware training; the straight-
+through estimator comes for free from jax.lax.stop_gradient composition in
+``ste``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.quantization import fake_quant
+
+
+def ste(x, qx):
+    """Straight-through estimator: forward qx, gradient of identity on x."""
+    return x + jax.lax.stop_gradient(qx - x)
+
+
+class SymQuantizer:
+    """Symmetric linear fake-quant, grouped along the last axis."""
+
+    @staticmethod
+    def quantize(x, bits: int = 8, group_size: int = 0):
+        g = group_size or x.shape[-1]
+        return ste(x, fake_quant(x, bits=bits, group_size=g, symmetric=True))
+
+
+class AsymQuantizer:
+    """Asymmetric (min/max) linear fake-quant."""
+
+    @staticmethod
+    def quantize(x, bits: int = 8, group_size: int = 0):
+        g = group_size or x.shape[-1]
+        return ste(x, fake_quant(x, bits=bits, group_size=g, symmetric=False))
+
+
+class TernaryQuantizer:
+    """Per-group ternarization: values in {-alpha, 0, +alpha} with the
+    threshold 0.7 * mean|x| and alpha = mean|x| over above-threshold entries."""
+
+    @staticmethod
+    def quantize(x, bits: int = 2, group_size: int = 0):
+        g = group_size or x.shape[-1]
+        orig = x.shape
+        xg = x.reshape(x.shape[:-1] + (x.shape[-1] // g, g)).astype(jnp.float32)
+        thresh = 0.7 * jnp.mean(jnp.abs(xg), axis=-1, keepdims=True)
+        mask = jnp.abs(xg) > thresh
+        alpha = jnp.sum(jnp.abs(xg) * mask, axis=-1, keepdims=True) / jnp.maximum(
+            jnp.sum(mask, axis=-1, keepdims=True), 1.0
+        )
+        q = jnp.sign(xg) * alpha * mask
+        return ste(x, q.reshape(orig).astype(x.dtype))
+
+
+class BinaryQuantizer:
+    """Per-group binarization: sign(x) * mean|x| (XNOR-style)."""
+
+    @staticmethod
+    def quantize(x, bits: int = 1, group_size: int = 0):
+        g = group_size or x.shape[-1]
+        orig = x.shape
+        xg = x.reshape(x.shape[:-1] + (x.shape[-1] // g, g)).astype(jnp.float32)
+        alpha = jnp.mean(jnp.abs(xg), axis=-1, keepdims=True)
+        q = jnp.sign(xg) * alpha
+        return ste(x, q.reshape(orig).astype(x.dtype))
+
+
+QUANTIZERS = {
+    "symmetric": SymQuantizer,
+    "asymmetric": AsymQuantizer,
+    "ternary": TernaryQuantizer,
+    "binary": BinaryQuantizer,
+}

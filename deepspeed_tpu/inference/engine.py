@@ -112,6 +112,26 @@ class InferenceEngine:
             params = jax.device_put(params, shardings)
         self.params = params
 
+        # --- weight-only int8/int4 quantization (reference: MoQ injection +
+        # int8 inference kernels, pt_binding int8 variants). Weights stay
+        # quantized in HBM; each scanned layer dequantizes its own slice.
+        qcfg = config.get("quantize", config.get("quant", {}))
+        if isinstance(qcfg, dict) and qcfg.get("enabled"):
+            bits = int(qcfg.get("bits", 8))
+            group_size = int(qcfg.get("group_size", 64))
+            if tp_size > 1:
+                raise NotImplementedError(
+                    "weight-only quantization with tensor parallelism is not "
+                    "supported yet; use tp_size=1"
+                )
+            self.cfg = self.cfg.replace(weight_bits=bits, weight_group_size=group_size)
+            self.params = jax.jit(
+                partial(tfm.quantize_weights, self.cfg, bits=bits, group_size=group_size)
+            )(self.params)
+            self.model = Model(self.cfg, loss_fn=self.model._loss)
+            self.model.set_mesh(self.mesh)
+            log_dist(f"weight-only quantization: int{bits}, group {group_size}", ranks=[0])
+
         self._fwd = None
         self._generate = {}
         n_params = sum(int(np.prod(s)) for s in jax.tree.leaves(shape_tree))

@@ -56,6 +56,11 @@ class TransformerConfig:
     flash_block_q: int = 0  # 0 = auto (ops/pallas/flash_attention._auto_block)
     flash_block_k: int = 0
     decode_attn: str = "kernel"  # kernel (Pallas length-aware) | xla (dense)
+    # weight-only quantization (inference): 0 = off; 8/4 = int bits. Weights
+    # stay quantized in HBM; each scanned layer dequantizes only its own
+    # slice (see quantize_weights / _dequant_layer).
+    weight_bits: int = 0
+    weight_group_size: int = 64
     remat: bool = False  # activation checkpointing over the layer scan
     # Remat policy names: any jax.checkpoint_policies attr, plus
     #   "save_flash"      — save only the flash kernel's out/lse residuals so
@@ -316,7 +321,63 @@ def _attn_out_proj(cfg: TransformerConfig, lp, attn_out):
     return out
 
 
+def quantize_weights(cfg: TransformerConfig, params: Params, bits: int = 8, group_size: int = 64) -> Params:
+    """Convert the stacked layer weight matrices to grouped int8/int4 storage
+    (weight-only quantization — the reference's int8 inference path,
+    csrc/transformer/inference pt_binding int8 variants + MoQ module_quantize).
+    Quantized leaves become {'q': int8 [L, ...], 's': fp32 scales}; LayerNorm
+    params and biases stay fp. Use with cfg.replace(weight_bits=bits)."""
+    from ..ops.quantization import quantize
+
+    from ..ops.quantization import pack_int4
+
+    new_layers = {}
+    for k, w in params["layers"].items():
+        if isinstance(w, dict):  # already quantized — idempotent
+            new_layers[k] = w
+        elif k.startswith("w") and w.ndim >= 3:
+            g = group_size if w.shape[-1] % group_size == 0 else w.shape[-1]
+            qt = quantize(w, bits=bits, group_size=g)
+            if bits == 4 and w.shape[-1] % 2 == 0:
+                # two int4 values per byte — int4 actually halves HBM
+                new_layers[k] = {"q4": pack_int4(qt.values), "s": qt.scale}
+            else:
+                new_layers[k] = {"q": qt.values, "s": qt.scale}
+        else:
+            new_layers[k] = w
+    out = dict(params)
+    out["layers"] = new_layers
+    return out
+
+
+def _dequant_layer(cfg: TransformerConfig, lp):
+    """Per-layer slice of quantized storage -> compute-dtype weights; no-op
+    for unquantized models."""
+    if not cfg.weight_bits:
+        return lp
+    from ..ops.quantization import QuantizedTensor, dequantize
+
+    from ..ops.quantization import unpack_int4
+
+    out = {}
+    for k, v in lp.items():
+        if isinstance(v, dict) and ("q" in v or "q4" in v):
+            values = unpack_int4(v["q4"]) if "q4" in v else v["q"]
+            # group size is recoverable from the shapes (quantize_weights may
+            # have fallen back to per-leaf grouping on non-divisible dims)
+            g = values.shape[-1] // v["s"].shape[-1]
+            qt = QuantizedTensor(
+                values=values, scale=v["s"], zero_point=None,
+                bits=cfg.weight_bits, group_size=g, shape=values.shape,
+            )
+            out[k] = dequantize(qt, dtype=cfg.dtype)
+        else:
+            out[k] = v
+    return out
+
+
 def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, positions):
+    lp = _dequant_layer(cfg, lp)
     x = carry  # [B, S, d] compute dtype
     h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
     q, k, v = _qkv_proj(cfg, lp, h, positions)
@@ -407,6 +468,7 @@ def apply(
 def _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions):
     from ..moe.layer import moe_ffn_apply
 
+    lp = _dequant_layer(cfg, lp)
     h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
     q, k, v = _qkv_proj(cfg, lp, h, positions)
     x = x + _attn_out_proj(cfg, lp, attn_fn(q, k, v, bias))
@@ -474,6 +536,7 @@ def apply_with_cache(
     def layer(carry, inputs):
         x = carry
         lp, k_cache, v_cache = inputs
+        lp = _dequant_layer(cfg, lp)
         h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
         q, k, v = _qkv_proj(cfg, lp, h, positions)
         k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))

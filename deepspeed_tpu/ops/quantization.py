@@ -1,0 +1,143 @@
+"""Grouped quantization ops — TPU-native equivalent of the reference's
+quantizer kernel set (csrc/quantization/pt_binding.cpp:62-75, quantizer.cu:
+ds_quantize / ds_sr_quantize / asymmetric variants).
+
+Everything is expressed as XLA ops (reductions + elementwise over reshaped
+groups fuse into a handful of kernels); stochastic rounding uses the jax PRNG
+where the CUDA kernels use curand. int4 values are stored in int8 (one value
+per byte — TPU has no sub-byte dtype; the HBM win of int4 comes from the
+packed storage helpers below).
+
+API (mirrors the binding surface):
+  quantize(x, bits, group_size, symmetric, stochastic, rng)
+      -> QuantizedTensor(values int8, scale fp32, zero_point fp32|None)
+  dequantize(qt) -> fp array
+  fake_quant(x, ...) -> x quantized-then-dequantized (QAT / MoQ forward)
+  pack_int4 / unpack_int4 -> 2x4bit per byte storage
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class QuantizedTensor(NamedTuple):
+    values: jnp.ndarray  # int8 (int4 values occupy [-8, 7])
+    scale: jnp.ndarray  # fp32 per group, broadcastable against groups
+    zero_point: Optional[jnp.ndarray]  # None for symmetric
+    bits: int
+    group_size: int
+    shape: tuple  # original shape
+
+    @property
+    def symmetric(self) -> bool:
+        return self.zero_point is None
+
+
+def _to_groups(x, group_size):
+    """[..., N] -> [..., N//G, G] grouping along the last axis."""
+    if group_size <= 0 or x.shape[-1] % group_size:
+        raise ValueError(
+            f"last dim {x.shape[-1]} must be divisible by group_size {group_size}"
+        )
+    return x.reshape(x.shape[:-1] + (x.shape[-1] // group_size, group_size))
+
+
+def quantize(
+    x: jnp.ndarray,
+    bits: int = 8,
+    group_size: int = 128,
+    symmetric: bool = True,
+    stochastic: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> QuantizedTensor:
+    """Grouped linear quantization along the last axis."""
+    if not 2 <= bits <= 8:
+        raise ValueError(f"bits must be in [2, 8] for int8 storage, got {bits}")
+    orig_shape = x.shape
+    g = _to_groups(x.astype(jnp.float32), group_size)
+    qmax = float(2 ** (bits - 1) - 1)  # 127 / 7
+    qmin = -qmax - 1
+
+    if symmetric:
+        absmax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+        q = g / scale
+        zero_point = None
+    else:
+        lo = jnp.min(g, axis=-1, keepdims=True)
+        hi = jnp.max(g, axis=-1, keepdims=True)
+        scale = jnp.where(hi > lo, (hi - lo) / (qmax - qmin), 1.0)
+        zero_point = lo - qmin * scale  # x = q * scale + zero_point... q = (x-zp)/scale
+        q = (g - zero_point) / scale
+
+    if stochastic:
+        if rng is None:
+            raise ValueError("stochastic rounding needs an rng key")
+        noise = jax.random.uniform(rng, q.shape) - 0.5
+        q = jnp.floor(q + 0.5 + noise)
+    else:
+        q = jnp.round(q)
+    q = jnp.clip(q, qmin, qmax).astype(jnp.int8)
+    return QuantizedTensor(
+        values=q.reshape(orig_shape),
+        scale=scale[..., 0],
+        zero_point=None if symmetric else zero_point[..., 0],
+        bits=bits,
+        group_size=group_size,
+        shape=tuple(orig_shape),
+    )
+
+
+def dequantize(qt: QuantizedTensor, dtype=jnp.float32) -> jnp.ndarray:
+    g = _to_groups(qt.values.astype(jnp.float32), qt.group_size)
+    out = g * qt.scale[..., None]
+    if qt.zero_point is not None:
+        out = out + qt.zero_point[..., None]
+    return out.reshape(qt.shape).astype(dtype)
+
+
+def fake_quant(x, bits=8, group_size=128, symmetric=True, stochastic=False, rng=None):
+    """Quantize-then-dequantize in the original dtype — the QAT forward used
+    by compression/ (reference compression/utils.py Sym/AsymQuantizer) and
+    MoQ (runtime/quantize.py). Supports bits in [2, 15] (no storage needed,
+    only rounding; >8 bits skips the int8 cast)."""
+    if bits <= 8:
+        qt = quantize(x, bits, group_size, symmetric, stochastic, rng)
+        return dequantize(qt, dtype=x.dtype)
+    g = _to_groups(x.astype(jnp.float32), group_size)
+    qmax = float(2 ** (bits - 1) - 1)
+    if symmetric:
+        absmax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+        q = jnp.clip(jnp.round(g / scale), -qmax - 1, qmax)
+        out = q * scale
+    else:
+        lo = jnp.min(g, axis=-1, keepdims=True)
+        hi = jnp.max(g, axis=-1, keepdims=True)
+        scale = jnp.where(hi > lo, (hi - lo) / (2 * qmax + 1), 1.0)
+        q = jnp.clip(jnp.round((g - lo) / scale), 0, 2 * qmax + 1)
+        out = q * scale + lo
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def pack_int4(values: jnp.ndarray) -> jnp.ndarray:
+    """int8 array of int4 values [-8, 7], even last dim -> packed uint8 of
+    half the size (low nibble first)."""
+    if values.shape[-1] % 2:
+        raise ValueError("last dim must be even to pack int4 pairs")
+    v = (values.astype(jnp.int32) & 0xF).astype(jnp.uint8)
+    lo, hi = v[..., 0::2], v[..., 1::2]
+    return lo | (hi << 4)
+
+
+def unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = ((packed >> 4) & 0xF).astype(jnp.int8)
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    out = jnp.stack([lo, hi], axis=-1)
+    return out.reshape(packed.shape[:-1] + (packed.shape[-1] * 2,))

@@ -221,6 +221,19 @@ class DeepSpeedEngine:
             self.state["master"] = master
             self._state_shardings["master"] = master_shardings
 
+        # MoQ / quantize-aware training (reference: runtime/quantize.py +
+        # compression/scheduler.py): step-scheduled fake-quant of the weights.
+        from ..compression.scheduler import CompressionScheduler, QuantScheduleConfig
+
+        qsc = QuantScheduleConfig.from_ds_config(raw if isinstance(raw, dict) else {})
+        self.quant_scheduler = CompressionScheduler(qsc) if qsc.enabled else None
+        if self.quant_scheduler and self.offload_optimizer_enabled:
+            raise NotImplementedError(
+                "quantize-during-training with offload_optimizer is unsupported "
+                "(the fake-quant must hit the host master weights)"
+            )
+        self._quant_fns: dict[int, Any] = {}
+
         # curriculum learning (reference engine hook: engine.py:1636-1642)
         self.curriculum_scheduler = None
         if self.config.curriculum_learning.enabled:
@@ -445,6 +458,8 @@ class DeepSpeedEngine:
         self.state, metrics = self._train_step(self.state, batch)
         self.tput_timer.stop()
         self.global_steps += 1
+        if self.quant_scheduler is not None:
+            self._maybe_quantize_weights()
         self.global_samples += self.train_batch_size
         need_host = (
             self.global_steps % self.config.steps_per_print == 0 or self.monitor.enabled
@@ -460,6 +475,41 @@ class DeepSpeedEngine:
                 ]
             )
         return metrics
+
+    def _maybe_quantize_weights(self):
+        """MoQ: fake-quantize the weight matrices at the scheduled bit-width
+        after each update (reference runtime/quantize.py semantics). One
+        compiled fn per distinct bit-width."""
+        bits = self.quant_scheduler.bits_at(self.global_steps)
+        if bits <= 0 or bits >= 16:
+            return
+        fn = self._quant_fns.get(bits)
+        if fn is None:
+            from ..ops.quantization import fake_quant
+
+            groups = self.quant_scheduler.cfg.quantize_groups
+            symmetric = self.quant_scheduler.cfg.quantization_type == "symmetric"
+
+            def quantize_params(params):
+                layers = {}
+                for k, w in params["layers"].items():
+                    if k.startswith("w") and w.ndim >= 3:
+                        # same per-leaf group fallback as quantize_weights so
+                        # QAT covers exactly the weights inference quantizes
+                        g = groups if w.shape[-1] % groups == 0 else w.shape[-1]
+                        layers[k] = fake_quant(
+                            w, bits=bits, group_size=g, symmetric=symmetric
+                        )
+                    else:
+                        layers[k] = w
+                out = dict(params)
+                out["layers"] = layers
+                return out
+
+            fn = self._quant_fns[bits] = jax.jit(
+                quantize_params, out_shardings=self._state_shardings["params"], donate_argnums=0
+            )
+        self.state["params"] = fn(self.state["params"])
 
     def _apply_curriculum(self, batch: dict) -> dict:
         """Seqlen curriculum: truncate token sequences to the scheduled

@@ -80,6 +80,25 @@ lx = tfm.apply(cfg_f.replace(attn_impl="xla"), params, toks)
 np.testing.assert_allclose(np.asarray(lf), np.asarray(lx), rtol=5e-3, atol=5e-3)
 ok.append("flash odd-length padding matches xla")
 
+# --- int8 weight-only inference + compression transforms --------------------
+eng8 = InferenceEngine(
+    model=Model(cfg), config={"dtype": "fp32", "quantize": {"enabled": True, "bits": 8, "group_size": 32}}
+)
+out8 = eng8.generate(prompt, max_new_tokens=5, temperature=0.0)
+assert out8.shape == (2, 5)
+from deepspeed_tpu.compression import init_compression
+
+params_c = tfm.init(cfg, jax.random.PRNGKey(0))
+m2, p2 = init_compression(Model(cfg), params_c, {
+    "compression_training": {
+        "layer_reduction": {"enabled": True, "keep_number_layer": 1},
+        "sparse_pruning": {"shared_parameters": {"enabled": True, "ratio": 0.3}},
+    }
+})
+toks1 = jnp.asarray(np.random.default_rng(3).integers(0, 211, size=(1, 16)), jnp.int32)
+assert np.isfinite(np.asarray(m2.apply(p2, toks1))).all()
+ok.append("int8 generate + compression transforms")
+
 print("VERIFY OK:")
 for line in ok:
     print(" -", line)

@@ -1,0 +1,222 @@
+"""Quantization + compression subsystem (VERDICT r02 ask #4).
+
+Reference surfaces being matched: csrc/quantization/pt_binding.cpp:62
+(grouped sym/asym quantize kernels), compression/utils.py:56-184
+(Sym/Asym/Ternary/Binary quantizers), compression/compress.py
+(init_compression / layer reduction / pruning), runtime/quantize.py (MoQ).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (
+    AsymQuantizer,
+    BinaryQuantizer,
+    CompressionScheduler,
+    QuantScheduleConfig,
+    SymQuantizer,
+    TernaryQuantizer,
+    apply_head_pruning,
+    apply_row_pruning,
+    apply_sparse_pruning,
+    init_compression,
+    reduce_layers,
+)
+from deepspeed_tpu.models.transformer import Model, TransformerConfig, quantize_weights
+from deepspeed_tpu.models import transformer as tfm
+from deepspeed_tpu.ops.quantization import (
+    dequantize,
+    fake_quant,
+    pack_int4,
+    quantize,
+    unpack_int4,
+)
+
+
+def test_quantize_roundtrip_int8():
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 256))
+    qt = quantize(x, bits=8, group_size=64)
+    assert qt.values.dtype == jnp.int8
+    assert qt.scale.shape == (16, 4)
+    err = np.abs(np.asarray(dequantize(qt)) - np.asarray(x))
+    # max error bounded by scale/2 per group
+    scales = np.asarray(qt.scale)
+    assert (err <= np.repeat(scales, 64, axis=-1).reshape(err.shape) * 0.5 + 1e-7).all()
+
+
+def test_quantize_asymmetric_handles_offset_data():
+    x = jax.random.uniform(jax.random.PRNGKey(1), (8, 128)) + 5.0  # all positive
+    sym = fake_quant(x, bits=4, group_size=128, symmetric=True)
+    asym = fake_quant(x, bits=4, group_size=128, symmetric=False)
+    err_sym = float(jnp.mean(jnp.abs(sym - x)))
+    err_asym = float(jnp.mean(jnp.abs(asym - x)))
+    assert err_asym < err_sym  # asym spends no codes on the empty negative range
+
+
+def test_stochastic_rounding_unbiased():
+    x = jnp.full((1, 128), 0.5003)
+    qt = quantize(x, bits=8, group_size=128)  # deterministic
+    outs = []
+    for i in range(32):
+        q = quantize(x, bits=8, group_size=128, stochastic=True, rng=jax.random.PRNGKey(i))
+        outs.append(np.asarray(dequantize(q)).mean())
+    # stochastic mean approaches the true value; deterministic always rounds
+    assert abs(np.mean(outs) - 0.5003) < abs(np.asarray(dequantize(qt)).mean() - 0.5003) + 1e-3
+
+
+def test_int4_pack_unpack():
+    v = jax.random.randint(jax.random.PRNGKey(0), (4, 32), -8, 8).astype(jnp.int8)
+    packed = pack_int4(v)
+    assert packed.shape == (4, 16)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)), np.asarray(v))
+
+
+def test_compression_quantizers():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 256))
+    for q in (SymQuantizer, AsymQuantizer):
+        out = q.quantize(x, bits=8, group_size=64)
+        assert float(jnp.max(jnp.abs(out - x))) < 0.1
+    t = TernaryQuantizer.quantize(x, group_size=64)
+    vals = np.unique(np.round(np.asarray(t), 6))
+    # per group {-a, 0, a}: few distinct magnitudes, 0 present
+    assert 0.0 in vals
+    b = BinaryQuantizer.quantize(x, group_size=256)
+    assert np.unique(np.abs(np.asarray(b)).round(6)).size <= 5  # one alpha per group
+
+    # straight-through gradient: d/dx sum(q(x)) == 1
+    g = jax.grad(lambda x: jnp.sum(SymQuantizer.quantize(x, 8, 64)))(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def _model(L=4):
+    cfg = TransformerConfig(
+        vocab_size=211, max_seq_len=64, num_layers=L, num_heads=4, hidden_size=32,
+        dtype=jnp.float32, loss_chunk_size=0,
+    )
+    params = tfm.init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_int8_weight_only_inference_close():
+    cfg, params = _model()
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 211, size=(2, 33)), jnp.int32)
+    ref = tfm.apply(cfg, params, toks)
+    qparams = quantize_weights(cfg, params, bits=8, group_size=32)
+    qcfg = cfg.replace(weight_bits=8, weight_group_size=32)
+    assert qparams["layers"]["wq"]["q"].dtype == jnp.int8
+    out = tfm.apply(qcfg, qparams, toks)
+    # logits drift bounded; argmax (greedy token) largely preserved
+    agree = (np.argmax(np.asarray(out), -1) == np.argmax(np.asarray(ref), -1)).mean()
+    assert agree > 0.9
+
+
+def test_int8_inference_engine_generate():
+    cfg, _ = _model()
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    eng = InferenceEngine(
+        model=Model(cfg), config={"dtype": "fp32", "quantize": {"enabled": True, "bits": 8, "group_size": 32}}
+    )
+    assert eng.cfg.weight_bits == 8
+    prompt = np.random.default_rng(0).integers(0, 211, size=(1, 8)).astype(np.int32)
+    out = eng.generate(prompt, max_new_tokens=4)
+    assert out.shape == (1, 4)
+
+
+def test_layer_reduction():
+    cfg, params = _model(L=4)
+    new_cfg, new_params = reduce_layers(cfg, params, [0, 3])
+    assert new_cfg.num_layers == 2
+    assert new_params["layers"]["wq"].shape[0] == 2
+    np.testing.assert_allclose(
+        np.asarray(new_params["layers"]["wq"][1]), np.asarray(params["layers"]["wq"][3])
+    )
+
+
+def test_pruning():
+    cfg, params = _model()
+    sp = apply_sparse_pruning(params, 0.5)
+    frac = float((np.asarray(sp["layers"]["wi"]) == 0).mean())
+    assert 0.4 < frac < 0.6
+    rp = apply_row_pruning(params, 0.25)
+    col_norms = np.linalg.norm(np.asarray(rp["layers"]["wi"]), axis=1)
+    np.testing.assert_allclose((col_norms == 0).mean(axis=-1), 0.25, atol=0.05)
+    hp = apply_head_pruning(params, 0.25)
+    head_norms = np.linalg.norm(
+        np.asarray(hp["layers"]["wo"]).reshape(cfg.num_layers, cfg.num_heads, -1), axis=-1
+    )
+    np.testing.assert_allclose((head_norms == 0).mean(axis=-1), 0.25, atol=0.05)
+
+
+def test_init_compression_config_driven():
+    from deepspeed_tpu.compression import redundancy_clean
+
+    cfg, params = _model(L=4)
+    model = Model(cfg)
+    ds = {
+        "compression_training": {
+            "layer_reduction": {"enabled": True, "keep_number_layer": 2},
+            "sparse_pruning": {"shared_parameters": {"enabled": True, "ratio": 0.5}},
+            "weight_quantization": {"shared_parameters": {"enabled": True, "target_bits": 8, "quantize_groups": 32}},
+        }
+    }
+    new_model, new_params = init_compression(model, params, ds)
+    assert new_model.config.num_layers == 2
+    # weight_quantization at init = QAT (engine fake-quant); params stay fp
+    assert not isinstance(new_params["layers"]["wq"], dict)
+    # post-training: redundancy_clean converts to int storage, idempotently
+    final_model, final_params = redundancy_clean(new_model, new_params, ds)
+    assert final_model.config.weight_bits == 8
+    assert final_params["layers"]["wq"]["q"].dtype == jnp.int8
+    again_model, again_params = redundancy_clean(final_model, final_params, ds)
+    assert again_model.config.num_layers == 2  # no double reduction / crash
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 211, size=(1, 17)), jnp.int32)
+    out = final_model.apply(final_params, toks)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_int4_packed_storage():
+    cfg, params = _model(L=2)
+    qparams = quantize_weights(cfg, params, bits=4, group_size=32)
+    qcfg = cfg.replace(weight_bits=4, weight_group_size=32)
+    wi = params["layers"]["wi"]
+    q4 = qparams["layers"]["wi"]["q4"]
+    assert q4.dtype == jnp.uint8 and q4.shape[-1] == wi.shape[-1] // 2  # halved HBM
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 211, size=(1, 9)), jnp.int32)
+    out = tfm.apply(qcfg, qparams, toks)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_quant_scheduler_and_moq_training():
+    sched = CompressionScheduler(QuantScheduleConfig(
+        enabled=True, start_bits=16, target_bits=8, quantize_period=2, schedule_offset=2
+    ))
+    assert sched.bits_at(0) == 0 and sched.bits_at(1) == 0
+    assert sched.bits_at(2) == 16 and sched.bits_at(3) == 16
+    assert sched.bits_at(4) == 8 and sched.bits_at(100) == 8
+
+    cfg, _ = _model(L=2)
+    ds_cfg = {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10**9, "mesh": {"data": -1},
+        "quantize_training": {
+            "enabled": True,
+            "quantize_bits": {"start_bits": 8, "target_bits": 8},
+            "quantize_schedule": {"quantize_period": 10, "schedule_offset": 1},
+            "quantize_groups": 32,
+        },
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=Model(cfg), config=ds_cfg)
+    batch = {"tokens": np.random.default_rng(0).integers(0, 211, size=(8, 65)).astype(np.int32)}
+    engine.train_batch(batch)  # step 1: offset reached -> weights fake-quantized
+    engine.train_batch(batch)
+    w = np.asarray(jax.device_get(engine.state["params"]["layers"]["wi"]))
+    # after fake-quant, each 32-group has <= 255 distinct values
+    g0 = w[0, 0, :32]
+    scale = np.abs(g0).max() / 127.0
+    np.testing.assert_allclose(g0 / scale, np.round(g0 / scale), atol=1e-3)
