@@ -73,6 +73,18 @@ def is_initialized() -> bool:
 
 
 def get_world_size(group: Optional[str] = None) -> int:
+    """Device count; with ``group`` = a mesh axis name (the TPU analogue of a
+    process group), the size of that axis on the most recently built mesh."""
+    if group is not None:
+        from .mesh import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None or group not in mesh.shape:
+            raise ValueError(
+                f"unknown group {group!r}: no active mesh axis by that name "
+                f"(have {list(mesh.shape) if mesh else 'no mesh'})"
+            )
+        return int(mesh.shape[group])
     return len(jax.devices())
 
 
@@ -81,18 +93,20 @@ def get_rank() -> int:
 
 
 def get_local_rank() -> int:
-    return 0  # one process drives all local devices under JAX
+    """Process index within its host. One JAX process drives all of a host's
+    chips, so this is the LOCAL_RANK the launcher exported (launcher/launch.py)
+    — 0 unless a per-chip launch scheme set it."""
+    return int(os.environ.get("LOCAL_RANK", 0))
 
 
 def barrier() -> None:
-    """Cross-process sync: block on a tiny psum over all devices."""
-    n = len(jax.devices())
-    if n == 1:
+    """True cross-process rendezvous (reference comm barrier): every process
+    must enter before any returns. No-op single-process."""
+    if jax.process_count() == 1:
         return
-    x = jnp.zeros((n,))
-    jax.block_until_ready(
-        jax.jit(lambda v: jnp.sum(v), out_shardings=None)(x)
-    )
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("deepspeed_tpu.barrier")
 
 
 # --------------------------------------------------------------------------

@@ -36,6 +36,14 @@ from ..utils.logging import logger
 # Canonical axis order, outermost first.
 AXIS_ORDER = ("pipe", "data", "fsdp", "context", "model")
 
+# Most recently built mesh — the "default process group" analogue, consulted
+# by comm.get_world_size(group=<axis name>).
+_CURRENT_MESH: list = [None]
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH[0]
+
 # Expert parallelism reuses the data/fsdp devices (reference: utils/groups.py:109
 # "expert parallel group is a subset of data parallel group").
 EXPERT_AXES = ("data", "fsdp")
@@ -84,6 +92,7 @@ def build_mesh(
     dev_array = np.asarray(devices).reshape(shape)
     mesh = Mesh(dev_array, axis_names=tuple(axis_names))
     logger.info(f"built mesh {dict(zip(axis_names, shape))} over {len(devices)} devices")
+    _CURRENT_MESH[0] = mesh
     return mesh
 
 

@@ -321,6 +321,19 @@ def _attn_out_proj(cfg: TransformerConfig, lp, attn_out):
     return out
 
 
+def quantizable_layer_leaves(layers: dict, group_size: int) -> dict[str, int]:
+    """{leaf name: effective group size} for the layer weights that weight
+    quantization (inference) and QAT fake-quant (engine MoQ hook) BOTH cover —
+    one predicate so the two paths can never diverge."""
+    out = {}
+    for k, w in layers.items():
+        if isinstance(w, dict):
+            continue  # already quantized
+        if k.startswith("w") and getattr(w, "ndim", 0) >= 3:
+            out[k] = group_size if w.shape[-1] % group_size == 0 else w.shape[-1]
+    return out
+
+
 def quantize_weights(cfg: TransformerConfig, params: Params, bits: int = 8, group_size: int = 64) -> Params:
     """Convert the stacked layer weight matrices to grouped int8/int4 storage
     (weight-only quantization — the reference's int8 inference path,
@@ -331,13 +344,11 @@ def quantize_weights(cfg: TransformerConfig, params: Params, bits: int = 8, grou
 
     from ..ops.quantization import pack_int4
 
+    targets = quantizable_layer_leaves(params["layers"], group_size)
     new_layers = {}
     for k, w in params["layers"].items():
-        if isinstance(w, dict):  # already quantized — idempotent
-            new_layers[k] = w
-        elif k.startswith("w") and w.ndim >= 3:
-            g = group_size if w.shape[-1] % group_size == 0 else w.shape[-1]
-            qt = quantize(w, bits=bits, group_size=g)
+        if k in targets:
+            qt = quantize(w, bits=bits, group_size=targets[k])
             if bits == 4 and w.shape[-1] % 2 == 0:
                 # two int4 values per byte — int4 actually halves HBM
                 new_layers[k] = {"q4": pack_int4(qt.values), "s": qt.scale}

@@ -100,6 +100,161 @@ def pipeline_apply(stage_fn, stage_params, x_mb, num_stages: int, mesh: Optional
     return constrain_mb(outs)
 
 
+def pipeline_train_1f1b(
+    stage_fn,
+    loss_head,
+    stage_params,
+    head_params,
+    x_mb,
+    labels_mb,
+    loss_scale,
+    num_stages: int,
+    mesh: Mesh,
+):
+    """Execute the clocked 1F1B TrainSchedule (pipe/schedule.py:144) as a
+    compiled shard_map program over the 'pipe' axis — the executed form of the
+    reference's ``_exec_schedule`` interpreter (runtime/pipe/engine.py:1359).
+
+    Per clock tick t, stage s runs ForwardPass of microbatch (t - s)/2 and/or
+    BackwardPass of microbatch (t - (2S-1-s))/2 — exactly the schedule's
+    closed-form clocks — with activations/gradients exchanged by ppermute
+    (Send/Recv{Activation,Grad}). Each stage stashes only the INPUTS of its
+    in-flight microbatches (<= S buffers — the 1F1B memory bound; GPipe's
+    autodiff-of-scan stores M + S - 1) and rebuilds the stage VJP at backward
+    time (activation recomputation, one extra forward per microbatch — the
+    same trade the engine's remat policy makes).
+
+    Args:
+      stage_fn:    (stage param slice [K, ...], h [mb, ...]) -> h
+      loss_head:   (head_params, h [mb, ...], labels [mb, ...]) -> scalar loss
+      stage_params: [S, K, ...] pytree sharded over 'pipe'
+      x_mb:        [M, mb, ...] embedded microbatch inputs
+      loss_scale:  scalar multiplied into the backward seed (fp16)
+    Returns (loss_mean, grads_stage [S,K,...], grads_head, grads_x [M,mb,...],
+    trace) where trace = (is_fwd, fwd_mb, is_bwd, bwd_mb) each [S, ticks] for
+    execution-order conformance tests against TrainSchedule.
+    """
+    from jax import shard_map
+
+    M = x_mb.shape[0]
+    S = num_stages
+    P = PartitionSpec
+    dp = ("data", "fsdp")
+    ticks = 2 * M + 2 * S - 2
+
+    stage_P = jax.tree.map(lambda _: P("pipe"), stage_params)
+    head_P = jax.tree.map(lambda _: P(), head_params)
+
+    def body(stage_p, head_p, x_mb, labels_mb, loss_scale):
+        s = lax.axis_index("pipe")
+        sp = jax.tree.map(lambda a: a[0], stage_p)  # local [K, ...]
+        mb_shape = x_mb.shape[1:]
+        msg0 = jnp.zeros(mb_shape, x_mb.dtype)
+        stash0 = jnp.zeros((S,) + mb_shape, x_mb.dtype)
+        gstage0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), sp)
+        ghead0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), head_p)
+        gx0 = jnp.zeros(x_mb.shape, jnp.float32)
+
+        def tick(carry, t):
+            fwd_msg, bwd_msg, stash, gstage, ghead, gx_all, loss_sum = carry
+            tf = t - s
+            is_fwd = (tf >= 0) & (tf % 2 == 0) & (tf // 2 < M)
+            mF = jnp.clip(tf // 2, 0, M - 1)
+            tb = t - (2 * S - 1 - s)
+            is_bwd = (tb >= 0) & (tb % 2 == 0) & (tb // 2 < M)
+            mB = jnp.clip(tb // 2, 0, M - 1)
+
+            x_first = lax.dynamic_index_in_dim(x_mb, mF, 0, keepdims=False)
+            x_in = jnp.where(s == 0, x_first, fwd_msg)
+
+            def do_fwd(stash):
+                y = stage_fn(sp, x_in)
+                return y, stash.at[mF % S].set(x_in)
+
+            y_f, stash = lax.cond(
+                is_fwd, do_fwd, lambda st: (jnp.zeros_like(msg0), st), stash
+            )
+
+            labels_b = lax.dynamic_index_in_dim(labels_mb, mB, 0, keepdims=False)
+
+            def do_bwd(op):
+                stash, gstage, ghead, gx_all, loss_sum = op
+                x_b = stash[mB % S]
+                y, pull = jax.vjp(lambda p, x: stage_fn(p, x), sp, x_b)
+
+                def last_seed(y):
+                    lv, pull2 = jax.vjp(
+                        lambda hp, yy: loss_head(hp, yy, labels_b), head_p, y
+                    )
+                    gh, gy = pull2(jnp.asarray(loss_scale, lv.dtype))
+                    return gy.astype(x_mb.dtype), gh, lv
+
+                def mid_seed(y):
+                    zh = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), head_p)
+                    return bwd_msg, zh, jnp.zeros((), jnp.float32)
+
+                gy, gh, lv = lax.cond(s == S - 1, last_seed, mid_seed, y)
+                gp, gx = pull(gy)
+                gstage = jax.tree.map(jnp.add, gstage, gp)
+                ghead = jax.tree.map(jnp.add, ghead, gh)
+                loss_sum = loss_sum + lv
+                # stage 0's input grad is the embedding cotangent; other
+                # stages write a no-op (their own current slice back)
+                gx_all = gx_all.at[mB].set(
+                    jnp.where(s == 0, gx.astype(jnp.float32), gx_all[mB])
+                )
+                return gx, (stash, gstage, ghead, gx_all, loss_sum)
+
+            gx_out, (stash, gstage, ghead, gx_all, loss_sum) = lax.cond(
+                is_bwd,
+                do_bwd,
+                lambda op: (jnp.zeros_like(msg0), op),
+                (stash, gstage, ghead, gx_all, loss_sum),
+            )
+
+            fwd_msg = lax.ppermute(y_f, "pipe", [(i, i + 1) for i in range(S - 1)])
+            bwd_msg = lax.ppermute(gx_out, "pipe", [(i, i - 1) for i in range(1, S)])
+            trace = (
+                is_fwd.astype(jnp.int32), mF.astype(jnp.int32),
+                is_bwd.astype(jnp.int32), mB.astype(jnp.int32),
+            )
+            return (fwd_msg, bwd_msg, stash, gstage, ghead, gx_all, loss_sum), trace
+
+        carry0 = (msg0, msg0, stash0, gstage0, ghead0, gx0, jnp.zeros((), jnp.float32))
+        (_, _, _, gstage, ghead, gx_all, loss_sum), trace = lax.scan(
+            tick, carry0, jnp.arange(ticks)
+        )
+        # reductions: 'pipe' collects the stage-local pieces (loss/head grads
+        # live on the last stage, embedding cotangents on stage 0); the dp
+        # axes average what pjit's implicit psum does in the autodiff path —
+        # each dp shard saw only its slice of every microbatch.
+        n_dp = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+        loss = lax.pmean(lax.psum(loss_sum, "pipe"), dp) / M
+        # grads of the MEAN loss over microbatches (matching autodiff of the
+        # model's batch-mean loss): divide the per-mb accumulation by M
+        ghead = jax.tree.map(lambda a: a / M, lax.pmean(lax.psum(ghead, "pipe"), dp))
+        gstage = jax.tree.map(lambda a: lax.pmean(a, dp) / M, gstage)
+        gx_all = lax.psum(gx_all, "pipe") / (n_dp * M)
+        gstage_out = jax.tree.map(lambda a: a[None], gstage)  # [1, K, ...]
+        trace = tuple(tr[None, :] for tr in trace)  # [1, ticks] per stage
+        return loss, gstage_out, ghead, gx_all, trace
+
+    sm = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(stage_P, head_P, P(None, dp), P(None, dp), P()),
+        out_specs=(
+            P(),
+            stage_P,
+            head_P,
+            P(None, dp),
+            (P("pipe"), P("pipe"), P("pipe"), P("pipe")),
+        ),
+        check_vma=False,
+    )
+    return sm(stage_params, head_params, x_mb, labels_mb, jnp.asarray(loss_scale, jnp.float32))
+
+
 class PipelineEngine(DeepSpeedEngine):
     """Engine for pipelined models (reference PipelineEngine,
     runtime/pipe/engine.py:36).
@@ -120,6 +275,12 @@ class PipelineEngine(DeepSpeedEngine):
                 f"(pipe.module.PipelinedTransformer or equivalent with {required}); "
                 f"missing attributes: {missing}"
             )
+        raw = config if isinstance(config, dict) else getattr(config, "raw", {})
+        self._pipe_schedule = (
+            (raw.get("pipeline", {}) or {}).get("schedule", "gpipe").lower()
+        )
+        if self._pipe_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"pipeline.schedule must be gpipe|1f1b, got {self._pipe_schedule}")
         super().__init__(model=model, config=config, **kwargs)
         # Config gas IS the microbatch count (reference pipe/engine.py:83).
         # A model left at the default adopts it; an explicit conflicting value
@@ -153,6 +314,81 @@ class PipelineEngine(DeepSpeedEngine):
             f"{model.layers_per_stage} layers, {self.micro_batches} microbatches",
             ranks=[0],
         )
+
+    def _make_micro_grad(self, compute_dtype):
+        """Under pipeline.schedule='1f1b' the gradients come from the executed
+        1F1B program (pipeline_train_1f1b) instead of autodiff-of-scan: embed
+        runs outside with its own VJP, stage grads flow through the clocked
+        schedule, and the head/embedding cotangents are stitched back in."""
+        if self._pipe_schedule != "1f1b":
+            return super()._make_micro_grad(compute_dtype)
+
+        from functools import partial
+
+        from ..models import transformer as tfm
+
+        model = self.model
+        cfg = model.config
+        mesh = self.mesh
+        S = self.num_stages
+        M = self.micro_batches
+
+        def micro_grad(params, batch, loss_scale):
+            cast = jax.tree.map(
+                lambda p: p.astype(compute_dtype) if p.dtype == jnp.float32 else p, params
+            )
+            p_stages = cast["layers"]
+            p_rest = {k: v for k, v in cast.items() if k != "layers"}
+            inputs, labels = tfm.split_batch(batch)
+            B, Sq = inputs.shape
+            mb = B // M
+            n_dp = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+            if mb % n_dp:
+                raise ValueError(
+                    f"1f1b: microbatch size {mb} (batch {B} / {M} microbatches) "
+                    f"must be divisible by the dp axes product {n_dp}"
+                )
+            # stage_fn runs INSIDE the executor's shard_map, where the batch
+            # dim is the per-dp-shard slice (all rows share the same arange)
+            positions = jnp.broadcast_to(jnp.arange(Sq)[None, :], (mb // n_dp, Sq))
+            bias = tfm.attn_bias(cfg, Sq)
+            attn_fn = tfm._attention_dispatch(cfg)
+
+            def embed_fn(p_rest):
+                x, _ = tfm.embed(cfg, p_rest, inputs)
+                return x.reshape((M, mb) + x.shape[1:])
+
+            x_mb, pull_embed = jax.vjp(embed_fn, p_rest)
+            labels_mb = labels.reshape((M, mb, Sq))
+
+            def stage_fn(sp, h):
+                body = partial(
+                    tfm._layer_body, cfg, attn_fn, alibi_bias=bias, positions=positions
+                )
+                if cfg.remat:
+                    body = jax.checkpoint(
+                        body, policy=tfm._remat_policy(cfg.remat_policy), prevent_cse=False
+                    )
+                h, _ = lax.scan(lambda c, lp: body(c, lp), h, sp)
+                return h
+
+            def loss_head(hp, y, labels_b):
+                h = tfm.layer_norm(
+                    y, hp["lnf_scale"], hp["lnf_bias"], cfg.layernorm_epsilon
+                )
+                return tfm.lm_loss_from_hidden(cfg, hp, h, labels_b)
+
+            loss, g_stage, g_head, gx, _trace = pipeline_train_1f1b(
+                stage_fn, loss_head, p_stages, p_rest, x_mb, labels_mb,
+                loss_scale, S, mesh,
+            )
+            (g_embed,) = pull_embed(gx.astype(x_mb.dtype))
+            g_rest = jax.tree.map(lambda a, b: a + b, g_head, g_embed)
+            grads = dict(g_rest)
+            grads["layers"] = g_stage
+            return loss, grads
+
+        return micro_grad
 
     def train_batch(self, batch=None, data_iter=None):
         """Reference signature accepts an iterator (pipe/engine.py:294)."""

@@ -264,8 +264,9 @@ class PipelinedTransformer(Model):
                 tfm._layer_body, cfg, attn_fn, alibi_bias=bias, positions=positions
             )
             if cfg.remat:
-                policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
-                body = jax.checkpoint(body, policy=policy, prevent_cse=False)
+                body = jax.checkpoint(
+                    body, policy=tfm._remat_policy(cfg.remat_policy), prevent_cse=False
+                )
             h, _ = lax.scan(lambda c, lp: body(c, lp), h, stage_params)
             return h
 

@@ -1,0 +1,125 @@
+"""Pluggable checkpoint engines (reference:
+runtime/checkpoint_engine/checkpoint_engine.py — torch + Nebula backends).
+
+The interface is storage-oriented: engines receive the engine state pytree +
+shardings and own durability. Two backends ship:
+
+  * NativeCheckpointEngine — the sharded multi-host-safe layout in
+    checkpoint/saver.py (per-shard .npy + manifest, async option)
+  * OrbaxCheckpointEngine  — delegates to orbax-checkpoint when installed
+    (async, OCDBT storage); soft import, registered only if available
+
+Select via config: {"checkpoint": {"engine": "native" | "orbax"}}.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+class CheckpointEngine:
+    def save(self, ckpt_dir: str, state, client_state: dict, async_save: bool = False,
+             latest: Optional[tuple] = None):
+        raise NotImplementedError
+
+    def load(self, ckpt_dir: str, state_like, shardings):
+        raise NotImplementedError
+
+    def commit(self):
+        """Block until the previous async save is durable."""
+        return True
+
+
+class NativeCheckpointEngine(CheckpointEngine):
+    def __init__(self):
+        self._pending = None
+
+    def save(self, ckpt_dir: str, state, client_state: dict, async_save: bool = False,
+             latest: Optional[tuple] = None):
+        from ...checkpoint.saver import save_checkpoint
+
+        self.commit()  # one in-flight save at a time
+        self._pending = save_checkpoint(
+            ckpt_dir, state, client_state=client_state, async_save=async_save,
+            latest=latest,
+        )
+        if not async_save:
+            self.commit()
+        return self._pending
+
+    def load(self, ckpt_dir: str, state_like, shardings):
+        from ...checkpoint.saver import load_checkpoint
+
+        return load_checkpoint(ckpt_dir, state_like, shardings)
+
+    def commit(self):
+        if self._pending is not None:
+            self._pending.wait()
+            self._pending = None
+        return True
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """orbax-checkpoint backend (PyTreeCheckpointer); partial-restore onto
+    the current shardings via restore_args."""
+
+    def __init__(self):
+        import orbax.checkpoint as ocp  # noqa: F401 — raises if unavailable
+
+        self._ocp = ocp
+        self._ckptr = ocp.PyTreeCheckpointer()
+
+    def save(self, ckpt_dir: str, state, client_state: dict, async_save: bool = False,
+             latest: Optional[tuple] = None):
+        import json
+
+        if async_save:
+            raise NotImplementedError(
+                "checkpoint.async_save with the orbax engine is not wired up "
+                "(PyTreeCheckpointer saves synchronously); use engine='native' "
+                "for async saves or drop async_save"
+            )
+        self._ckptr.save(os.path.join(ckpt_dir, "orbax"), state, force=True)
+        import jax
+
+        if jax.process_index() == 0:
+            with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
+                json.dump(client_state or {}, f)
+            if latest is not None:
+                lpath, tag = latest
+                with open(lpath, "w") as f:
+                    f.write(tag)
+        return None
+
+    def load(self, ckpt_dir: str, state_like, shardings):
+        import json
+
+        import jax
+
+        ocp = self._ocp
+        restore_args = jax.tree.map(
+            lambda s: ocp.ArrayRestoreArgs(sharding=s) if s is not None else ocp.RestoreArgs(),
+            shardings,
+        )
+        state = self._ckptr.restore(
+            os.path.join(ckpt_dir, "orbax"), item=state_like, restore_args=restore_args
+        )
+        cs_path = os.path.join(ckpt_dir, "client_state.json")
+        client_state = {}
+        if os.path.exists(cs_path):
+            with open(cs_path) as f:
+                client_state = json.load(f)
+        return state, client_state
+
+
+def get_checkpoint_engine(name: Optional[str]) -> CheckpointEngine:
+    name = (name or "native").lower()
+    if name == "native":
+        return NativeCheckpointEngine()
+    if name == "orbax":
+        try:
+            return OrbaxCheckpointEngine()
+        except Exception as e:
+            raise RuntimeError(f"orbax checkpoint engine unavailable: {e}") from e
+    raise ValueError(f"unknown checkpoint engine {name!r} (native | orbax)")

@@ -1,0 +1,114 @@
+"""Scalable sharded checkpointing (VERDICT r02 ask #6).
+
+Reference behaviors matched: per-rank shard files + tag protocol
+(runtime/engine.py:2877/:2467), elastic re-partitioning on load
+(stage_1_and_2.py:2068), zero_to_fp32 consolidation (utils/zero_to_fp32.py),
+pluggable checkpoint engines (runtime/checkpoint_engine/).
+"""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.saver import (
+    consolidate_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+
+def _engine(mesh_cfg, zero_stage=3, ckpt_cfg=None, micro=1):
+    cfg = TransformerConfig(
+        vocab_size=128, max_seq_len=32, num_layers=2, num_heads=4, hidden_size=32,
+        dtype=jnp.float32, loss_chunk_size=0,
+    )
+    ds = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": zero_stage},
+        "steps_per_print": 10**9,
+        "mesh": mesh_cfg,
+    }
+    if ckpt_cfg:
+        ds["checkpoint"] = ckpt_cfg
+    engine, _, _, _ = deepspeed_tpu.initialize(model=Model(cfg), config=ds)
+    return engine
+
+
+def _batch():
+    return {"tokens": np.random.default_rng(0).integers(0, 128, size=(8, 33)).astype(np.int32)}
+
+
+def test_sharded_files_written(tmp_path):
+    e = _engine({"data": 2, "fsdp": 4}, zero_stage=3)
+    e.train_batch(_batch())
+    e.save_checkpoint(str(tmp_path))
+    tag = open(tmp_path / "latest").read()
+    d = tmp_path / tag
+    manifest = json.loads((d / "manifest.json").read_text())
+    # fsdp-sharded leaves produce one file per distinct shard, not one blob
+    wte = manifest["leaves"]["params::wte"]
+    # zero-3 shards the embed axis over (fsdp x data) = 8 distinct shards
+    assert "shards" in wte and len(wte["shards"]) == 8
+    assert len(glob.glob(str(d / "params::wte.shard*.npy"))) == 8
+    # replicated scalars are single 'full' files
+    assert "file" in manifest["leaves"]["step"]
+
+
+def test_cross_topology_reshard(tmp_path):
+    e1 = _engine({"data": -1}, zero_stage=2)  # dp=8
+    e1.train_batch(_batch())
+    e1.save_checkpoint(str(tmp_path), tag="t0")
+    ref = np.asarray(jax.device_get(e1.state["params"]["layers"]["wi"]))
+    ref_m = np.asarray(jax.device_get(e1.state["opt"]["m"]["layers"]["wi"]))
+
+    # load into a tp x fsdp = 2 x 4 mesh under ZeRO-3 (params sharded)
+    e2 = _engine({"fsdp": 4, "model": 2}, zero_stage=3, micro=2)
+    e2.load_checkpoint(str(tmp_path), tag="t0")
+    got = np.asarray(jax.device_get(e2.state["params"]["layers"]["wi"]))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    got_m = np.asarray(jax.device_get(e2.state["opt"]["m"]["layers"]["wi"]))
+    np.testing.assert_allclose(got_m, ref_m, rtol=1e-6)
+    # and training continues
+    m = e2.train_batch(_batch())
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
+def test_async_save_and_latest_ordering(tmp_path):
+    e = _engine({"data": -1}, zero_stage=1, ckpt_cfg={"engine": "native", "async_save": True})
+    e.train_batch(_batch())
+    e.save_checkpoint(str(tmp_path))
+    # commit() must make the save durable; 'latest' appears only after
+    e.checkpoint_engine.commit()
+    assert os.path.exists(tmp_path / "latest")
+    tag = open(tmp_path / "latest").read()
+    assert os.path.exists(tmp_path / tag / "manifest.json")
+    before = np.asarray(jax.device_get(e.state["params"]["wte"]))
+    e.state["params"]["wte"] = e.state["params"]["wte"] * 0
+    e.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(jax.device_get(e.state["params"]["wte"])), before)
+
+
+def test_consolidate(tmp_path):
+    e = _engine({"fsdp": 8}, zero_stage=3)
+    e.save_checkpoint(str(tmp_path), tag="c0")
+    full = consolidate_checkpoint(str(tmp_path / "c0"))
+    wte = np.asarray(jax.device_get(e.state["params"]["wte"]))
+    np.testing.assert_allclose(full["params::wte"], wte)
+    assert full["params::wte"].shape == (128, 32)
+
+
+def test_low_level_roundtrip_missing_leaf(tmp_path):
+    # missing leaves keep current values (load_module_strict=False analogue)
+    state = {"a": jnp.ones((4, 4)), "b": jnp.zeros((2,))}
+    save_checkpoint(str(tmp_path / "x"), {"a": state["a"]})
+    out, _ = load_checkpoint(str(tmp_path / "x"), state)
+    np.testing.assert_allclose(np.asarray(out["a"]), 1.0)
+    np.testing.assert_allclose(np.asarray(out["b"]), 0.0)

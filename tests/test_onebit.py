@@ -1,0 +1,94 @@
+"""1-bit Adam + comm-shim honesty (VERDICT r02 ask #9).
+
+Reference surfaces matched: OnebitAdam (runtime/fp16/onebit/adam.py:10) with
+warmup-then-compressed phases, error feedback, frozen variance; honest
+barrier/get_world_size/get_local_rank shims.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import comm
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+
+def _cfg(opt_type, opt_params, **kw):
+    return {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": opt_type, "params": opt_params},
+        "zero_optimization": {"stage": 0},
+        "gradient_clipping": 0.0,
+        "steps_per_print": 10**9,
+        "mesh": {"data": -1},
+        **kw,
+    }
+
+
+def _model():
+    return Model(TransformerConfig(
+        vocab_size=128, max_seq_len=32, num_layers=2, num_heads=2, hidden_size=32,
+        dtype=jnp.float32, loss_chunk_size=0,
+    ))
+
+
+def _batch(seed=0):
+    return {"tokens": np.random.default_rng(seed).integers(0, 128, size=(8, 33)).astype(np.int32)}
+
+
+def test_onebit_warmup_matches_adamw():
+    """During warmup 1-bit Adam IS AdamW over the pmean'd gradient."""
+    e_ob, _, _, _ = deepspeed_tpu.initialize(
+        model=_model(), config=_cfg("OneBitAdam", {"lr": 1e-3, "freeze_step": 100})
+    )
+    e_ref, _, _, _ = deepspeed_tpu.initialize(
+        model=_model(), config=_cfg("AdamW", {"lr": 1e-3, "weight_decay": 0.0})
+    )
+    for i in range(3):
+        b = _batch(i)
+        l_ob = float(jax.device_get(e_ob.train_batch(b)["loss"]))
+        l_ref = float(jax.device_get(e_ref.train_batch(b)["loss"]))
+        assert l_ob == pytest.approx(l_ref, rel=1e-5)
+    w_ob = np.asarray(jax.device_get(e_ob.state["params"]["wte"]))
+    w_ref = np.asarray(jax.device_get(e_ref.state["params"]["wte"]))
+    np.testing.assert_allclose(w_ob, w_ref, rtol=1e-4, atol=1e-6)
+
+
+def test_onebit_compressed_stage_trains():
+    e, _, _, _ = deepspeed_tpu.initialize(
+        model=_model(), config=_cfg("OneBitAdam", {"lr": 1e-3, "freeze_step": 2})
+    )
+    b = _batch()
+    losses = [float(jax.device_get(e.train_batch(b)["loss"])) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    # after freeze_step the error-feedback buffers are live (nonzero)
+    err = np.asarray(jax.device_get(e.state["opt"]["error"]["wte"]))
+    assert err.shape[0] == 8  # one slice per dp rank
+    assert np.abs(err).max() > 0
+    # v frozen: value after step 2 persists
+    v_now = np.asarray(jax.device_get(e.state["opt"]["v"]["wte"]))
+    e.train_batch(b)
+    v_next = np.asarray(jax.device_get(e.state["opt"]["v"]["wte"]))
+    np.testing.assert_array_equal(v_now, v_next)
+
+
+def test_onebit_rejects_zero23_and_lamb():
+    with pytest.raises(ValueError, match="zero stage"):
+        cfg = _cfg("OneBitAdam", {"lr": 1e-3})
+        cfg["zero_optimization"] = {"stage": 2}
+        deepspeed_tpu.initialize(model=_model(), config=cfg)
+    with pytest.raises(NotImplementedError, match="OneBitAdam"):
+        deepspeed_tpu.initialize(model=_model(), config=_cfg("OneBitLamb", {"lr": 1e-3}))
+
+
+def test_comm_shims_honest(mesh8):
+    assert comm.get_world_size() == 8
+    assert comm.get_world_size("data") == 8  # mesh8 puts all devices on data
+    assert comm.get_world_size("model") == 1
+    with pytest.raises(ValueError, match="unknown group"):
+        comm.get_world_size("nonexistent_axis")
+    assert comm.get_local_rank() == 0
+    comm.barrier()  # single-process: no-op, must not hang
