@@ -1,0 +1,1 @@
+from .autotuner import DEFAULT_SPACE, Autotuner, Trial, TuneResult  # noqa: F401

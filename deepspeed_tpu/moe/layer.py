@@ -44,3 +44,30 @@ def moe_ffn_apply(cfg, moe_params, h: jnp.ndarray, mesh=None):
         mesh=mesh,
     )
     return out.reshape(B, S, M), aux
+
+
+def moe_ffn_dense(cfg, moe_params, h: jnp.ndarray):
+    """Capacity-free MoE for DECODE: every token gets its exact top-k expert
+    mix, no dropping. With a handful of tokens per step the capacity
+    heuristic (tokens * factor / experts) degenerates to ~1 slot and drops
+    colliding tokens; computing all experts densely costs E small GEMMs —
+    negligible at decode batch sizes and bitwise-stable (the reference's
+    inference MoE routes without capacity drops, moe_inference.py)."""
+    B, S, M = h.shape
+    x = h.reshape(B * S, M)
+    logits = x @ moe_params["gate"].astype(x.dtype)  # [T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    if cfg.moe_top_k < probs.shape[-1]:
+        vals, _ = jax.lax.top_k(probs, cfg.moe_top_k)
+        thresh = vals[..., -1:]
+        probs = jnp.where(probs >= thresh, probs, 0.0)
+        if cfg.moe_top_k >= 2:
+            # GShard renormalizes only multi-expert mixes (top2_gating:92);
+            # top-1 keeps the raw gate prob as the scale (top1_gating:56)
+            probs = probs / jnp.maximum(probs.sum(-1, keepdims=True), 1e-9)
+    # every expert on every token: [E, T, M]
+    E = probs.shape[-1]
+    xe = jnp.broadcast_to(x[None], (E,) + x.shape)
+    ye = apply_experts(moe_params["experts"], xe)  # [E, T, M]
+    out = jnp.einsum("te,etm->tm", probs.astype(x.dtype), ye)
+    return out.reshape(B, S, M)

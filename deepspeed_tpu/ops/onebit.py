@@ -69,8 +69,10 @@ def momentum_sync(g_local, m, v, error_local, step, cfg: OneBitAdamConfig, dp_ax
     error_new_local). ``g_local`` is this rank's UNREDUCED gradient;
     ``error_local`` has a leading [1] axis (the rank's shard).
 
-    step < freeze_step:  m/v from the pmean'd gradient (plain Adam moments)
-    step >= freeze_step: v frozen; m = pmean(scale * sign(m_local + error)),
+    step <= freeze_step: m/v from the pmean'd gradient (plain Adam moments) —
+                         compression begins at freeze_step + 1, matching the
+                         reference's boundary
+    step >  freeze_step: v frozen; m = pmean(scale * sign(m_local + error)),
                          error updated with the compression residual.
 
     The two phases are a ``lax.cond`` (the predicate is replicated, so every

@@ -1,0 +1,10 @@
+from .kernels import sparse_flash_attention  # noqa: F401
+from .sparsity_config import (  # noqa: F401
+    SPARSITY_CONFIGS,
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    SparsityConfig,
+    VariableSparsityConfig,
+)

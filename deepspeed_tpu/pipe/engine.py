@@ -333,7 +333,8 @@ class PipelineEngine(DeepSpeedEngine):
         S = self.num_stages
         M = self.micro_batches
 
-        def micro_grad(params, batch, loss_scale):
+        def micro_grad(params, batch, loss_scale, rng=None, step=None):
+            # dropout/PLD are rejected at PipelinedTransformer construction
             cast = jax.tree.map(
                 lambda p: p.astype(compute_dtype) if p.dtype == jnp.float32 else p, params
             )

@@ -222,6 +222,11 @@ class PipelinedTransformer(Model):
             f"num_layers={cfg.num_layers} must divide evenly into {num_stages} stages"
         )
         assert cfg.moe_every == 0, "MoE+PP composition is not supported yet"
+        if cfg.hidden_dropout > 0 or cfg.attn_dropout > 0 or cfg.pld_enabled:
+            raise NotImplementedError(
+                "dropout/progressive-layer-drop under pipeline parallelism is "
+                "not wired up (per-stage rng routing); disable them"
+            )
         super().__init__(cfg, loss_fn=None)
         self.num_stages = num_stages
         self.num_micro_batches = num_micro_batches

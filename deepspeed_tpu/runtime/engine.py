@@ -144,6 +144,7 @@ class DeepSpeedEngine:
         )
 
         self._acknowledge_compiler_managed_knobs(raw)
+        self._enforce_elasticity(raw)
 
         # ---- sharding rules --------------------------------------------------
         zstage = self.config.zero_optimization.stage
@@ -208,6 +209,17 @@ class DeepSpeedEngine:
             if self.offload_optimizer_enabled:
                 raise NotImplementedError("onebitadam with offload_optimizer is unsupported")
             self._onebit_cfg = OneBitAdamConfig.from_params(opt_cfg.params)
+            mcfg = getattr(model, "config", None)
+            if mcfg is not None and (
+                getattr(mcfg, "hidden_dropout", 0.0) > 0
+                or getattr(mcfg, "attn_dropout", 0.0) > 0
+                or getattr(mcfg, "pld_enabled", False)
+            ):
+                raise NotImplementedError(
+                    "onebitadam + dropout/progressive-layer-drop is not wired "
+                    "up (the compressed step does not thread rng/step); "
+                    "disable them or use adam/adamw"
+                )
             self.opt_init = self.opt_update = None
             base_lr = self._onebit_cfg.lr
         elif opt_type in ("onebitlamb", "zerooneadam"):
@@ -364,6 +376,34 @@ class DeepSpeedEngine:
             )
 
     # ------------------------------------------------------------------
+    def _enforce_elasticity(self, raw):
+        """Runtime enforcement of the elastic batch contract (reference
+        engine.py:472-481): with elasticity enabled, the configured batch
+        sizes must be the elastic solution for the CURRENT world size."""
+        el = raw.get("elasticity", {}) if isinstance(raw, dict) else {}
+        if not el.get("enabled"):
+            return
+        from ..elasticity import ElasticityError, compute_elastic_config
+
+        final_batch, valid_gpus, micro = compute_elastic_config(
+            {"elasticity": el}, world_size=self.dp_world
+        )
+        if el.get("ignore_non_elastic_batch_info", False):
+            log_dist(
+                f"elasticity: ignoring configured batch sizes; elastic solution "
+                f"is train={final_batch}, micro={micro} for world {self.dp_world}",
+                ranks=[0],
+            )
+            return
+        if self.train_batch_size != final_batch:
+            raise ElasticityError(
+                f"elastic training requires train_batch_size={final_batch} at "
+                f"world size {self.dp_world} (valid worlds: {valid_gpus}); config "
+                f"has {self.train_batch_size}. Set elasticity."
+                f"ignore_non_elastic_batch_info to override."
+            )
+
+    # ------------------------------------------------------------------
     def _to_host_shardings(self, shardings):
         """Retarget a sharding tree to host memory when the optimizer is
         offloaded (no-op otherwise / on backends without memory kinds)."""
@@ -471,7 +511,7 @@ class DeepSpeedEngine:
         params_P = rep(self.state["params"])
         mv_P = rep(self.state["opt"]["m"])
         err_P = jax.tree.map(lambda _: P(("data", "fsdp")), self.state["opt"]["error"])
-        batch_P = jax.tree.map(lambda _: self.batch_spec, {"x": 0})["x"]
+        batch_P = self.batch_spec  # pytree prefix: applies to every batch leaf
 
         def loss_fn(params, mb, loss_scale):
             cast = jax.tree.map(
@@ -504,28 +544,35 @@ class DeepSpeedEngine:
                 jnp.stack([jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(g)])
             )
             finite = lax.pmin(finite_local.astype(jnp.int32), dp_axes)
+            # gradient-norm estimate: RMS-combined per-rank norms (exact when
+            # shards agree; the exact global norm would need the full-grad
+            # pmean the compressed stage exists to avoid)
+            gsq = lax.pmean(
+                jnp.sum(jnp.stack([jnp.sum(jnp.square(x)) for x in jax.tree.leaves(g)])),
+                dp_axes,
+            )
+            gnorm = jnp.sqrt(gsq)
             m_new, v_new, err_new = ob.momentum_sync(g, m, v, error, step1, obc, dp_axes)
-            return loss, finite, m_new, v_new, err_new
+            return loss, finite, gnorm, m_new, v_new, err_new
 
         sm = shard_map(
             sharded_phase,
             mesh=mesh,
             in_specs=(params_P, mv_P, mv_P, err_P, batch_P, P(), P()),
-            out_specs=(P(), P(), mv_P, mv_P, err_P),
+            out_specs=(P(), P(), P(), mv_P, mv_P, err_P),
             check_vma=False,
         )
 
         def train_step(state, batch):
             step1 = state["step"] + 1
             loss_scale = state["loss_scale"]
-            loss, finite_i, m_new, v_new, err_new = sm(
+            loss, finite_i, gnorm, m_new, v_new, err_new = sm(
                 state["params"], state["opt"]["m"], state["opt"]["v"],
                 state["opt"]["error"], batch, step1, loss_scale,
             )
             finite = finite_i > 0
             lr = self.lr_schedule(step1)
             new_params = ob.apply_update(state["params"], m_new, v_new, step1, lr, obc)
-            gnorm = _global_norm(m_new)
 
             if self.fp16_enabled and fp16.loss_scale == 0:
                 new_scale, good, hyst = _dynamic_loss_scale(
@@ -566,22 +613,42 @@ class DeepSpeedEngine:
         )
 
     # ------------------------------------------------------------------
+    @property
+    def _dropout_enabled(self) -> bool:
+        """True when the model wants per-step stochastics (dropout or
+        progressive layer drop) — the engine then threads rng/step through."""
+        mcfg = getattr(self.model, "config", None)
+        return bool(
+            mcfg is not None
+            and (
+                getattr(mcfg, "hidden_dropout", 0.0) > 0
+                or getattr(mcfg, "attn_dropout", 0.0) > 0
+                or getattr(mcfg, "pld_enabled", False)
+            )
+        )
+
     def _make_micro_grad(self, compute_dtype):
         """One micro-batch's (loss, grads-of-scaled-loss). Overridable hook:
-        PipelineEngine swaps in the executed-1F1B gradient program."""
+        PipelineEngine swaps in the executed-1F1B gradient program. ``rng`` is
+        the per-micro-step dropout key (None when dropout is off)."""
         model = self.model
 
-        def loss_fn(params, mb, loss_scale):
+        dropout = self._dropout_enabled
+
+        def loss_fn(params, mb, loss_scale, rng, step):
             cast = jax.tree.map(
                 lambda p: p.astype(compute_dtype) if p.dtype == jnp.float32 else p, params
             )
-            loss = model.loss(cast, mb)
+            # only stochastic models need (or necessarily accept) rng/step
+            loss = (
+                model.loss(cast, mb, rng=rng, step=step) if dropout else model.loss(cast, mb)
+            )
             return loss * loss_scale, loss
 
         vg = jax.value_and_grad(loss_fn, has_aux=True)
 
-        def micro_grad(params, mb, loss_scale):
-            (_, loss), grads = vg(params, mb, loss_scale)
+        def micro_grad(params, mb, loss_scale, rng=None, step=None):
+            (_, loss), grads = vg(params, mb, loss_scale, rng, step)
             return loss, grads
 
         return micro_grad
@@ -605,6 +672,8 @@ class DeepSpeedEngine:
         apply_update = self._make_apply_update()
         micro_grad = self._make_micro_grad(compute_dtype)
 
+        dropout = self._dropout_enabled
+
         def train_step(state, batch):
             params = state["params"]
             loss_scale = state["loss_scale"]
@@ -613,11 +682,16 @@ class DeepSpeedEngine:
                 return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
 
             batch_g = jax.tree.map(reshape_leaf, batch)
+            # per-micro dropout keys, deterministic in the global step
+            micro_rngs = jax.random.split(
+                jax.random.fold_in(jax.random.PRNGKey(0), state["step"] + 1), gas
+            )
 
             zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
             zero_grads = shd.constrain(zero_grads, mesh, grad_specs)
 
-            def micro(carry, mb):
+            def micro(carry, mb_rng):
+                mb, rng = mb_rng
                 g_acc, l_acc = carry
                 mb = jax.tree.map(
                     lambda x: jax.lax.with_sharding_constraint(
@@ -625,11 +699,15 @@ class DeepSpeedEngine:
                     ) if x.ndim >= 2 else x,
                     mb,
                 )
-                loss, grads = micro_grad(params, mb, loss_scale)
+                loss, grads = micro_grad(
+                    params, mb, loss_scale, rng if dropout else None, state["step"] + 1
+                )
                 grads = shd.constrain(grads, mesh, grad_specs)
                 return (_tree_add(g_acc, grads), l_acc + loss), None
 
-            (grads, loss_sum), _ = jax.lax.scan(micro, (zero_grads, jnp.zeros((), jnp.float32)), batch_g)
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro, (zero_grads, jnp.zeros((), jnp.float32)), (batch_g, micro_rngs)
+            )
             loss = loss_sum / gas
             inv = 1.0 / (loss_scale * gas)
             grads = _tree_scale(grads, inv)
@@ -823,11 +901,16 @@ class DeepSpeedEngine:
         self._loss_eval = jax.jit(loss_of)
         self._eval_fn = self._loss_eval
 
+        dropout = self._dropout_enabled
+
         def grad_of(state, batch):
             def f(params):
                 cast = jax.tree.map(
                     lambda p: p.astype(compute_dtype) if p.dtype == jnp.float32 else p, params
                 )
+                if dropout:
+                    rng = jax.random.fold_in(jax.random.PRNGKey(0), state["step"] + 1)
+                    return model.loss(cast, batch, rng=rng, step=state["step"] + 1) * state["loss_scale"]
                 return model.loss(cast, batch) * state["loss_scale"]
 
             g = jax.grad(f)(state["params"])
@@ -939,6 +1022,13 @@ class DeepSpeedEngine:
             ck = self.config.raw.get("checkpoint", {}) if hasattr(self.config, "raw") else {}
             self._ckpt_engine = get_checkpoint_engine(ck.get("engine"))
             self._ckpt_async = bool(ck.get("async_save", False))
+            if self._ckpt_async:
+                # the last save of a run must still become durable (manifest +
+                # 'latest' are written by commit()) even if the user never
+                # saves again before the process exits
+                import atexit
+
+                atexit.register(self._ckpt_engine.commit)
         return self._ckpt_engine
 
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: dict | None = None):

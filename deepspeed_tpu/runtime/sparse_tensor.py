@@ -1,0 +1,74 @@
+"""Sparse gradient representation + sparse all-reduce.
+
+Reference: ``runtime/sparse_tensor.py:11`` (SparseTensor wrapping torch
+sparse COO) + ``engine.py:2297 sparse_allreduce`` — embedding gradients are
+exchanged as (indices, values) instead of the dense [V, D] matrix.
+
+TPU framing: under pjit the gradient reduction is compiled, and XLA already
+keeps the embedding backward as a scatter-add — a dense all-reduce of [V, D]
+only materializes if the user asks for it. The sparse path here is for
+shard_map custom reductions (e.g. the 1-bit engine's dp phase) and for
+host-side exchange: rows are gathered by token id with a static row-count
+bound (padded; TPU needs static shapes).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class SparseTensor(NamedTuple):
+    """Row-sparse matrix: ``values[i]`` is the dense row at ``indices[i]``;
+    ``count`` rows are valid (static-shape padding after it)."""
+
+    indices: jnp.ndarray  # [N] int32 row ids (padded entries = 0)
+    values: jnp.ndarray  # [N, D]
+    count: jnp.ndarray  # scalar int32
+    dense_shape: tuple  # (num_rows, D)
+
+    def to_dense(self) -> jnp.ndarray:
+        n = self.indices.shape[0]
+        mask = (jnp.arange(n) < self.count)[:, None]
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.indices].add(jnp.where(mask, self.values, 0))
+
+
+def from_embedding_grad(token_ids: jnp.ndarray, row_grads: jnp.ndarray,
+                        vocab_size: int) -> SparseTensor:
+    """tokens [T] + per-occurrence grads [T, D] -> SparseTensor over [V, D].
+    Duplicate token ids keep separate rows (to_dense scatter-adds them),
+    matching torch COO semantics before coalescing."""
+    T, D = row_grads.shape
+    return SparseTensor(
+        indices=token_ids.astype(jnp.int32),
+        values=row_grads,
+        count=jnp.asarray(T, jnp.int32),
+        dense_shape=(vocab_size, D),
+    )
+
+
+def sparse_all_reduce(st: SparseTensor, axis) -> SparseTensor:
+    """All-reduce by concatenating every rank's (indices, values) along the
+    mesh axis (reference sparse_allreduce_bucket: all_gather of indices +
+    values, engine.py:2323). Use inside shard_map; result rows = N * axis
+    size, still row-sparse — densify with ``to_dense`` or keep sparse."""
+    idx = lax.all_gather(st.indices, axis, tiled=True)
+    vals = lax.all_gather(st.values, axis, tiled=True)
+    counts = lax.all_gather(st.count, axis)  # [world]
+    count = jnp.sum(counts)
+    # gathered blocks are [world * N]; each block's valid rows are its prefix,
+    # so zero padded rows' values (they would otherwise scatter garbage)
+    n = st.indices.shape[0]
+    local_pos = jnp.arange(idx.shape[0]) % n
+    mask = (local_pos < jnp.repeat(counts, n))[:, None]
+    vals = jnp.where(mask, vals, 0)
+    # count becomes the total VALID rows across blocks (to_dense masks by
+    # position, so report the full padded length to keep every block's prefix)
+    return SparseTensor(
+        indices=idx, values=vals, count=jnp.asarray(idx.shape[0], jnp.int32),
+        dense_shape=st.dense_shape,
+    )

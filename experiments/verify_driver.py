@@ -144,6 +144,32 @@ with tempfile.TemporaryDirectory() as d:
     assert full["params::wte"].shape == (211, 32)
 ok.append("sharded checkpoint consolidation")
 
+# --- sparse attention + PLD + autotuner (1 trial) ---------------------------
+cfg_sp = cfg.replace(attn_impl="sparse", max_seq_len=256,
+                     sparsity={"mode": "bslongformer", "block": 128,
+                               "num_sliding_window_blocks": 1})
+tfm._ACTIVE_MESH[0] = None
+p_sp = tfm.init(cfg_sp, jax.random.PRNGKey(0))
+t_sp = jnp.asarray(np.random.default_rng(7).integers(0, 211, size=(1, 256)), jnp.int32)
+assert np.isfinite(np.asarray(tfm.apply(cfg_sp, p_sp, t_sp))).all()
+ok.append("block-sparse attention forward")
+
+cfg_pld = cfg.replace(pld_enabled=True)
+e_pld, _, _, _ = deepspeed_tpu.initialize(model=Model(cfg_pld), config=ds_cfg)
+lp0 = float(jax.device_get(e_pld.train_batch(batch)["loss"]))
+assert np.isfinite(lp0)
+ok.append("progressive layer drop trains")
+
+from deepspeed_tpu.autotuning import Autotuner
+
+tuner = Autotuner(
+    lambda o: Model(cfg), ds_cfg,
+    lambda: batch, steps=1, warmup=0,
+)
+res = tuner.tune(space={"zero_stage": [1]}, strategy="grid")
+assert res.best is not None and res.best.tokens_per_sec > 0
+ok.append(f"autotuner trial {res.best.tokens_per_sec:,.0f} tok/s")
+
 print("VERIFY OK:")
 for line in ok:
     print(" -", line)

@@ -1,0 +1,109 @@
+"""Block-sparse attention (VERDICT r02 ask #8).
+
+Reference surfaces matched: SparsityConfig family
+(ops/sparse_attention/sparsity_config.py: Dense/Fixed/Variable/BigBird/
+BSLongformer) + the block-sparse attention kernels (matmul.py:11). Numerics
+are validated against dense attention with the equivalent block mask.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import transformer as tfm
+from deepspeed_tpu.models.transformer import TransformerConfig, xla_attention
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    VariableSparsityConfig,
+    sparse_flash_attention,
+)
+from deepspeed_tpu.ops.sparse_attention.kernels import layout_to_lists
+
+B, S, H, D = 2, 512, 2, 32
+BLK = 128
+
+
+def _qkv(seed=0):
+    r = jax.random.PRNGKey(seed)
+    return tuple(jax.random.normal(k, (B, S, H, D), jnp.float32) for k in jax.random.split(r, 3))
+
+
+def _dense_ref(q, k, v, layout, causal=True):
+    blk = S // layout.shape[-1]
+    m = np.kron(np.asarray(layout[0], bool), np.ones((blk, blk), bool))
+    if causal:
+        m &= np.tril(np.ones((S, S), bool))
+    bias = jnp.where(jnp.asarray(m), 0.0, -1e30)[None, None]
+    return xla_attention(q, k, v, bias=bias)
+
+
+CONFIGS = [
+    ("fixed", FixedSparsityConfig(H, block=BLK, num_local_blocks=2, num_global_blocks=1)),
+    ("bigbird", BigBirdSparsityConfig(H, block=BLK, num_random_blocks=1, num_sliding_window_blocks=3)),
+    ("bslongformer", BSLongformerSparsityConfig(H, block=BLK, num_sliding_window_blocks=3)),
+    ("variable", VariableSparsityConfig(H, block=BLK, local_window_blocks=[1, 2], global_block_indices=[0])),
+    ("dense", DenseSparsityConfig(H, block=BLK)),
+]
+
+
+@pytest.mark.parametrize("name,cfg", CONFIGS, ids=[c[0] for c in CONFIGS])
+def test_sparse_matches_dense_with_mask(name, cfg):
+    q, k, v = _qkv()
+    layout = cfg.make_layout(S)
+    out = sparse_flash_attention(q, k, v, layout, causal=True)
+    ref = _dense_ref(q, k, v, layout)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_gradients_match():
+    q, k, v = _qkv(1)
+    cfg = BigBirdSparsityConfig(H, block=BLK, num_random_blocks=1)
+    layout = cfg.make_layout(S)
+    gs = jax.grad(
+        lambda q, k, v: jnp.sum(jnp.square(sparse_flash_attention(q, k, v, layout))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(
+        lambda q, k, v: jnp.sum(jnp.square(_dense_ref(q, k, v, layout))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, n in zip(gs, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4, err_msg=f"d{n}")
+
+
+def test_layout_to_lists_compression():
+    layout = np.zeros((4, 4), np.int64)
+    layout[np.arange(4), np.arange(4)] = 1  # diagonal
+    layout[:, 0] = 1  # global first block
+    kl, kc, ql, qc = layout_to_lists(layout, causal=True)
+    assert kl.shape[1] == 2  # at most {0, diag}
+    np.testing.assert_array_equal(kc, [1, 2, 2, 2])
+    np.testing.assert_array_equal(qc, [4, 1, 1, 1])
+    # padded entries repeat the last valid block (hot re-fetch)
+    assert kl[0, 1] == kl[0, 0]
+
+
+def test_sparse_in_model_trains():
+    cfg = TransformerConfig(
+        vocab_size=128, max_seq_len=256, num_layers=2, num_heads=2, hidden_size=64,
+        dtype=jnp.float32, loss_chunk_size=0, attn_impl="sparse",
+        sparsity={"mode": "bslongformer", "block": 128, "num_sliding_window_blocks": 1},
+    )
+    params = tfm.init(cfg, jax.random.PRNGKey(0))
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 128, size=(2, 257)), jnp.int32)
+    loss, grads = jax.value_and_grad(
+        lambda p: tfm.causal_lm_loss(cfg, p, {"tokens": toks})
+    )(params)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g)).all() for g in jax.tree.leaves(grads))
+
+
+def test_empty_row_rejected():
+    layout = np.zeros((2, 2), np.int64)
+    layout[0, 0] = 1  # row 1 empty after tril
+    with pytest.raises(ValueError, match="no keys"):
+        layout_to_lists(layout, causal=True)
