@@ -121,21 +121,17 @@ class Autotuner:
         return cfg
 
     def _dp_size(self, cfg) -> int:
+        """data x fsdp product with any single -1 wildcard axis resolved the
+        way MeshConfig.sizes does (remaining devices)."""
         mesh = cfg.get("mesh", {})
         n = len(jax.devices())
-        fixed = 1
-        minus_one = False
-        for k in ("pipe", "data", "fsdp", "context", "model"):
-            v = mesh.get(k, -1 if k == "data" else 1)
-            if v == -1:
-                minus_one = True
-            else:
-                fixed *= v
-        dp = mesh.get("data", -1)
-        fsdp = mesh.get("fsdp", 1)
-        if dp == -1:
-            dp = n // fixed
-        return dp * (fsdp if fsdp > 0 else 1)
+        sizes = {k: mesh.get(k, -1 if k == "data" else 1)
+                 for k in ("pipe", "data", "fsdp", "context", "model")}
+        unknown = [k for k, v in sizes.items() if v == -1]
+        fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+        if unknown:
+            sizes[unknown[0]] = max(1, n // fixed)
+        return sizes["data"] * sizes["fsdp"]
 
     # -- cost model (reference: model-based tuner; here the flops profiler
     # estimate ranks candidates before any compilation) ---------------------
@@ -171,7 +167,11 @@ class Autotuner:
                 m = engine.train_batch(batch)
             np.asarray(jax.device_get(m["loss"]))
             dt = (time.perf_counter() - t0) / self.steps
-            tokens = int(np.prod(next(iter(batch.values())).shape[:2]))
+            leaf = next(iter(batch.values()))
+            # causal-LM batches carry S+1 columns (inputs + shifted labels);
+            # count the S positions actually trained
+            seq = leaf.shape[1] - 1 if "tokens" in batch else leaf.shape[1]
+            tokens = int(leaf.shape[0] * seq)
             trial.step_ms = dt * 1e3
             trial.tokens_per_sec = tokens / dt
             trial.status = "ok"
@@ -191,21 +191,22 @@ class Autotuner:
         seed: int = 0,
     ) -> TuneResult:
         space = space or DEFAULT_SPACE
-        candidates = self._expand(space)
+        candidates = [(0.0, c) for c in self._expand(space)]
         if strategy == "random":
             pyrandom.Random(seed).shuffle(candidates)
         elif strategy == "model_based":
-            for c in candidates:
-                c["_rank"] = self._cost_rank(c)
-            candidates.sort(key=lambda c: c.pop("_rank"))
+            candidates = sorted(
+                ((self._cost_rank(c), c) for _, c in candidates), key=lambda rc: rc[0]
+            )
         elif strategy != "grid":
             raise ValueError(f"unknown strategy {strategy!r} (grid|random|model_based)")
         candidates = candidates[:max_trials]
 
         result = TuneResult(best=None)
-        for i, overrides in enumerate(candidates):
+        for i, (rank, overrides) in enumerate(candidates):
             log_dist(f"autotune trial {i + 1}/{len(candidates)}: {overrides}", ranks=[0])
             trial = self._measure(overrides)
+            trial.cost_rank = rank
             result.trials.append(trial)
             if trial.status == "ok" and (
                 result.best is None or trial.tokens_per_sec > result.best.tokens_per_sec

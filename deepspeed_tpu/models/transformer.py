@@ -46,10 +46,18 @@ class TransformerConfig:
     intermediate_size: Optional[int] = None  # default 4*hidden
     pos_emb: str = "learned"  # learned | rotary | alibi | none
     rotary_pct: float = 1.0
+    rotary_interleaved: bool = False  # GPT-J rotate-every-two convention
     parallel_residual: bool = False  # GPT-NeoX style
+    causal: bool = True  # False = bidirectional (BERT-style encoders)
+    norm_style: str = "pre"  # pre (GPT) | post (BERT) layernorm placement
+    # GPT-Neo alternating local attention: window size + per-layer 0/1 flags
+    # (1 = local); None = all-global
+    local_attn_window: int = 0
+    local_attn_layers: Optional[tuple] = None
     layernorm_epsilon: float = 1e-5
     tie_embeddings: bool = True
     use_bias: bool = True
+    final_ln: bool = True  # False: no final LayerNorm (BERT encoders)
     activation: str = "gelu"  # gelu | gelu_exact | relu
     embed_ln: bool = False  # LayerNorm after embedding (BLOOM)
     attn_impl: str = "xla"  # xla | flash | ring | sparse
@@ -223,10 +231,12 @@ def layer_norm(x, scale, bias, eps):
     return out.astype(x.dtype)
 
 
-def rotary_embed(x, positions, rotary_dims):
+def rotary_embed(x, positions, rotary_dims, interleaved: bool = False):
     """Apply rotary position embedding to the first ``rotary_dims`` of x
     [B, S, H, Dh] (reference inference kernel: apply_rotary_pos_emb,
-    csrc/transformer/inference/csrc/pt_binding.cpp:1268)."""
+    csrc/transformer/inference/csrc/pt_binding.cpp:1268). ``interleaved``
+    selects GPT-J's rotate-every-two pairing ((x0,x1),(x2,x3),...) instead of
+    the NeoX half-split ((x0,x_half),...)."""
     rd = rotary_dims
     x_rot, x_pass = x[..., :rd], x[..., rd:]
     half = rd // 2
@@ -234,8 +244,14 @@ def rotary_embed(x, positions, rotary_dims):
     angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [B,S,half]
     cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
     sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
-    x1, x2 = x_rot[..., :half], x_rot[..., half:]
-    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if interleaved:
+        x1, x2 = x_rot[..., 0::2], x_rot[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        rotated = jnp.stack([r1, r2], axis=-1).reshape(x_rot.shape)
+    else:
+        x1, x2 = x_rot[..., :half], x_rot[..., half:]
+        rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return jnp.concatenate([rotated, x_pass], axis=-1)
 
 
@@ -251,19 +267,21 @@ def alibi_slopes(num_heads: int) -> jnp.ndarray:
     return base
 
 
-def xla_attention(q, k, v, *, causal_offset=0, bias=None, dtype=jnp.float32):
+def xla_attention(q, k, v, *, causal_offset=0, bias=None, causal=True, dtype=jnp.float32):
     """Plain einsum attention [B,S,H,Dh] — the baseline the Pallas flash
     kernel is validated against (mirrors tests vs vendored BERT in the
-    reference's test_cuda_forward.py strategy)."""
+    reference's test_cuda_forward.py strategy). ``causal=False`` gives the
+    bidirectional encoder form (BERT)."""
     B, Sq, H, Dh = q.shape
     Sk = k.shape[1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(Dh)
     if bias is not None:
         scores = scores + bias
-    q_pos = jnp.arange(Sq)[:, None] + causal_offset
-    k_pos = jnp.arange(Sk)[None, :]
-    mask = q_pos >= k_pos
-    scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    if causal:
+        q_pos = jnp.arange(Sq)[:, None] + causal_offset
+        k_pos = jnp.arange(Sk)[None, :]
+        mask = q_pos >= k_pos
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -285,11 +303,12 @@ def _attention_dispatch(cfg: TransformerConfig):
 
         bq = cfg.flash_block_q or None
         bk = cfg.flash_block_k or None
-        # additive bias (alibi) is not fused — those layers take the XLA path
+        # additive bias (alibi/local windows) is not fused — those layers
+        # take the XLA path
         return lambda q, k, v, bias: (
-            flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+            flash_attention(q, k, v, causal=cfg.causal, block_q=bq, block_k=bk)
             if bias is None
-            else xla_attention(q, k, v, bias=bias)
+            else xla_attention(q, k, v, bias=bias, causal=cfg.causal)
         )
     if cfg.attn_impl == "ring":
         from ..parallel.ring_attention import ring_attention_sharded
@@ -305,12 +324,12 @@ def _attention_dispatch(cfg: TransformerConfig):
 
         def sparse_fn(q, k, v, bias):
             if bias is not None:
-                return xla_attention(q, k, v, bias=bias)  # alibi unfused
+                return xla_attention(q, k, v, bias=bias, causal=cfg.causal)  # alibi unfused
             layout = sparsity_cfg.make_layout(q.shape[1])
-            return sparse_flash_attention(q, k, v, layout, causal=True)
+            return sparse_flash_attention(q, k, v, layout, causal=cfg.causal)
 
         return sparse_fn
-    return lambda q, k, v, bias: xla_attention(q, k, v, bias=bias)
+    return lambda q, k, v, bias: xla_attention(q, k, v, bias=bias, causal=cfg.causal)
 
 
 def _ffn(cfg, lp, h):
@@ -340,8 +359,8 @@ def _qkv_proj(cfg: TransformerConfig, lp, h, positions):
         v = v + lp["bv"].astype(h.dtype)
     if cfg.pos_emb == "rotary":
         rd = int(cfg.head_dim * cfg.rotary_pct)
-        q = rotary_embed(q, positions, rd)
-        k = rotary_embed(k, positions, rd)
+        q = rotary_embed(q, positions, rd, interleaved=cfg.rotary_interleaved)
+        k = rotary_embed(k, positions, rd, interleaved=cfg.rotary_interleaved)
     return q, k, v
 
 
@@ -428,10 +447,22 @@ def _dropout(x, rate: float, rng):
     return jnp.where(keep, x / (1.0 - rate), jnp.zeros((), x.dtype))
 
 
-def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, positions):
+def _local_attn_bias(cfg: TransformerConfig, S: int):
+    """Additive [S, S] window mask for GPT-Neo-style local attention."""
+    w = cfg.local_attn_window
+    dist = jnp.arange(S)[:, None] - jnp.arange(S)[None, :]
+    return jnp.where((dist >= 0) & (dist < w), 0.0, NEG_BIAS).astype(jnp.float32)
+
+
+NEG_BIAS = -1e30
+
+
+def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, positions,
+                local_bias=None):
     lp = dict(lp)
     rng = lp.pop("_rng", None)
     pld_keep = lp.pop("_pld_keep", None)  # scalar keep-prob for this layer
+    is_local = lp.pop("_local", None)  # 0/1 flag for local-window attention
     lp = _dequant_layer(cfg, lp)
     if rng is not None:
         k_attn, k_hidden, k_pld = jax.random.split(rng, 3)
@@ -441,10 +472,25 @@ def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, position
     gate = jnp.ones((), cfg.dtype)
     if pld_keep is not None and k_pld is not None:
         gate = jax.random.bernoulli(k_pld, pld_keep).astype(cfg.dtype)
+    bias = alibi_bias
+    if is_local is not None and local_bias is not None:
+        lb = jnp.where(is_local.astype(bool), local_bias, 0.0)[None, None]
+        bias = lb if bias is None else bias + lb
     x = carry  # [B, S, d] compute dtype
+
+    if cfg.norm_style == "post":
+        # BERT layout: sublayer -> residual add -> LayerNorm
+        q, k, v = _qkv_proj(cfg, lp, x, positions)
+        attn_out = _attn_out_proj(cfg, lp, attn_fn(q, k, v, bias))
+        attn_out = gate * _dropout(attn_out, cfg.attn_dropout, k_attn)
+        x = layer_norm(x + attn_out, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
+        f = gate * _dropout(_ffn(cfg, lp, x), cfg.hidden_dropout, k_hidden)
+        x = layer_norm(x + f, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_epsilon)
+        return x, None
+
     h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
     q, k, v = _qkv_proj(cfg, lp, h, positions)
-    attn_out = _attn_out_proj(cfg, lp, attn_fn(q, k, v, alibi_bias))
+    attn_out = _attn_out_proj(cfg, lp, attn_fn(q, k, v, bias))
     attn_out = gate * _dropout(attn_out, cfg.attn_dropout, k_attn)
 
     if cfg.parallel_residual:
@@ -502,9 +548,17 @@ def apply(
         x = _dropout(x, cfg.hidden_dropout, k_emb)
     bias = attn_bias(cfg, S)
     attn_fn = _attention_dispatch(cfg)
-    body = partial(_layer_body, cfg, attn_fn, alibi_bias=bias, positions=positions)
+    local_bias = None
+    if cfg.local_attn_window > 0 and cfg.local_attn_layers is not None:
+        local_bias = _local_attn_bias(cfg, S)
+    body = partial(
+        _layer_body, cfg, attn_fn, alibi_bias=bias, positions=positions,
+        local_bias=local_bias,
+    )
 
     layers_xs = params["layers"]
+    if local_bias is not None:
+        layers_xs = dict(layers_xs, _local=jnp.asarray(cfg.local_attn_layers, jnp.int32))
     needs_rng = cfg.hidden_dropout > 0 or cfg.attn_dropout > 0 or cfg.pld_enabled
     if rng is not None and needs_rng:
         layers_xs = dict(layers_xs, _rng=jax.random.split(rng, L))
@@ -538,7 +592,7 @@ def apply(
                 dense_part = jax.tree.map(lambda a: a[: E - 1], lg)
                 x, _ = lax.scan(scan_body, x, dense_part)
             lp_last = jax.tree.map(lambda a: a[E - 1], lg)
-            x, aux = _moe_layer(cfg, lp_last, moe_p, x, attn_fn, bias, positions)
+            x, aux = _moe_layer(cfg, lp_last, moe_p, x, attn_fn, bias, positions, local_bias)
             return x, aux
 
         x, auxs = lax.scan(maybe_remat(group_body), x, (layers_g, params["moe"]))
@@ -549,14 +603,15 @@ def apply(
             lp = jax.tree.map(lambda a: a[i], layers_xs)
             if (i + 1) % E == 0 and "moe" in params:
                 moe_p = jax.tree.map(lambda a: a[(i + 1) // E - 1], params["moe"])
-                x, aux = _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions)
+                x, aux = _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions, local_bias)
                 aux_total = aux_total + aux
             else:
                 x, _ = body(x, lp)
     else:
         x, _ = lax.scan(maybe_remat(scan_body), x, layers_xs)
 
-    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon)
+    if cfg.final_ln:
+        x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon)
     if return_hidden:
         return (x, aux_total) if with_aux else x
     head = params.get("lm_head", None)
@@ -564,15 +619,18 @@ def apply(
         head = params["wte"].T
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
     logits = logits.astype(jnp.float32)
+    if "lm_head_bias" in params:
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
     return (logits, aux_total) if with_aux else logits
 
 
-def _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions):
+def _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions, local_bias=None):
     from ..moe.layer import moe_ffn_apply
 
     lp = dict(lp)
     rng = lp.pop("_rng", None)
     pld_keep = lp.pop("_pld_keep", None)
+    is_local = lp.pop("_local", None)
     lp = _dequant_layer(cfg, lp)
     if rng is not None:
         k_attn, k_hidden, k_pld = jax.random.split(rng, 3)
@@ -581,6 +639,9 @@ def _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions):
     gate = jnp.ones((), cfg.dtype)
     if pld_keep is not None and k_pld is not None:
         gate = jax.random.bernoulli(k_pld, pld_keep).astype(cfg.dtype)
+    if is_local is not None and local_bias is not None:
+        lb = jnp.where(is_local.astype(bool), local_bias, 0.0)[None, None]
+        bias = lb if bias is None else bias + lb
     h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
     q, k, v = _qkv_proj(cfg, lp, h, positions)
     attn_out = gate * _dropout(_attn_out_proj(cfg, lp, attn_fn(q, k, v, bias)), cfg.attn_dropout, k_attn)
@@ -628,6 +689,17 @@ def apply_with_cache(
         raise NotImplementedError(
             "apply_with_cache with MoE needs num_layers divisible by moe_every "
             "and materialized expert params"
+        )
+    if not cfg.causal:
+        raise NotImplementedError("KV-cache decoding is causal-only (encoders use apply())")
+    if cfg.local_attn_layers is not None:
+        raise NotImplementedError(
+            "local-attention decode is not wired up; use apply() for GPT-Neo-style models"
+        )
+    if cfg.attn_impl == "sparse":
+        raise NotImplementedError(
+            "block-sparse decode is not wired up — dense cache attention would "
+            "silently change the attention pattern the model trained with"
         )
     B, T = tokens.shape
     positions = pos + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
@@ -720,11 +792,14 @@ def apply_with_cache(
         x, (new_k, new_v) = lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
     if last_only:
         x = x[:, -1:]
-    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon)
+    if cfg.final_ln:
+        x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon)
     head = params.get("lm_head", None)
     if head is None:
         head = params["wte"].T
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype)).astype(jnp.float32)
+    if "lm_head_bias" in params:
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v}
 
 
@@ -809,9 +884,12 @@ class Model:
         import inspect
 
         try:
-            self._loss_takes_rng = "rng" in inspect.signature(self._loss).parameters
+            sig = inspect.signature(self._loss).parameters
+            self._loss_takes_rng = "rng" in sig
+            self._loss_takes_step = "step" in sig
         except (TypeError, ValueError):
             self._loss_takes_rng = False
+            self._loss_takes_step = False
         self.mesh = None  # set by the engine for MoE sharding constraints
 
     def set_mesh(self, mesh):
@@ -828,7 +906,7 @@ class Model:
         kw = {}
         if rng is not None and self._loss_takes_rng:
             kw["rng"] = rng
-        if step is not None and self.config.pld_enabled and self._loss_takes_rng:
+        if step is not None and self.config.pld_enabled and self._loss_takes_step:
             kw["step"] = step
         return self._loss(self.config, params, batch, **kw)
 

@@ -15,9 +15,9 @@ qkv conventions handled:
   * NeoX/BLOOM  [3d, d]: per-head interleave — output rows grouped as
     (head, {q,k,v}, head_dim) (torch Linear, [out, in])
 
-Not covered this round: GPT-J (interleaved even/odd rotary) and GPT-Neo
-(alternating local attention) — they need model-family variants, not just
-weight maps.
+GPT-J (interleaved rotary), GPT-Neo (alternating local attention, unscaled
+scores) and BERT (bidirectional post-LN encoder) are covered via the model
+family's rotary_interleaved / local_attn_* / causal+norm_style switches.
 """
 
 from __future__ import annotations
@@ -400,12 +400,213 @@ class MegatronLayerPolicy(DSPolicy):
         return cfg, params
 
 
+class HFGPTJLayerPolicy(DSPolicy):
+    """GPTJForCausalLM (reference replace_policy.py:174): interleaved
+    (rotate-every-two) rotary over rotary_dim, single-LN parallel residual
+    (mapped by duplicating ln_1 into the family's ln2 slot), no qkv/out
+    biases, untied biased lm head."""
+
+    model_type = "gptj"
+
+    def build_config(self, hf, dtype) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf.vocab_size,
+            max_seq_len=hf.n_positions,
+            num_layers=hf.n_layer,
+            num_heads=hf.n_head,
+            hidden_size=hf.n_embd,
+            intermediate_size=hf.n_inner or 4 * hf.n_embd,
+            pos_emb="rotary",
+            rotary_pct=(hf.rotary_dim or (hf.n_embd // hf.n_head)) / (hf.n_embd // hf.n_head),
+            rotary_interleaved=True,
+            parallel_residual=True,
+            activation=_map_activation(getattr(hf, "activation_function", "gelu_new")),
+            layernorm_epsilon=hf.layer_norm_epsilon,
+            tie_embeddings=False,
+            dtype=dtype,
+        )
+
+    def convert(self, hf, sd, dtype):
+        cfg = self.build_config(hf, dtype)
+        H, Dh, d = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+        p = {k: _t2np(v) for k, v in sd.items()}
+        pre = "transformer." if any(k.startswith("transformer.") for k in p) else ""
+        layers = []
+        zeros_hd = np.zeros((H, Dh), np.float32)
+        for i in range(cfg.num_layers):
+            b = f"{pre}h.{i}."
+            lp = {
+                "ln1_scale": p[b + "ln_1.weight"],
+                "ln1_bias": p[b + "ln_1.bias"],
+                # GPT-J has ONE layernorm feeding both branches
+                "ln2_scale": p[b + "ln_1.weight"],
+                "ln2_bias": p[b + "ln_1.bias"],
+                "wq": p[b + "attn.q_proj.weight"].T.reshape(d, H, Dh),
+                "wk": p[b + "attn.k_proj.weight"].T.reshape(d, H, Dh),
+                "wv": p[b + "attn.v_proj.weight"].T.reshape(d, H, Dh),
+                "bq": zeros_hd, "bk": zeros_hd, "bv": zeros_hd,  # bias-free attn
+                "wo": p[b + "attn.out_proj.weight"].T.reshape(H, Dh, d),
+                "bo": np.zeros((d,), np.float32),
+                "wi": p[b + "mlp.fc_in.weight"].T,
+                "bi": p[b + "mlp.fc_in.bias"],
+                "wo_mlp": p[b + "mlp.fc_out.weight"].T,
+                "bo_mlp": p[b + "mlp.fc_out.bias"],
+            }
+            layers.append(lp)
+        params = {
+            "wte": p[pre + "wte.weight"],
+            "layers": _stack(layers),
+            "lnf_scale": p[pre + "ln_f.weight"],
+            "lnf_bias": p[pre + "ln_f.bias"],
+            "lm_head": p["lm_head.weight"].T,
+            "lm_head_bias": p["lm_head.bias"],
+        }
+        return cfg, params
+
+
+class HFGPTNeoLayerPolicy(DSPolicy):
+    """GPTNeoForCausalLM (reference replace_policy.py:129): alternating
+    global/local attention (window mask), UNSCALED attention scores (folded
+    into wq at conversion: q' = q * sqrt(head_dim)), bias-free qkv."""
+
+    model_type = "gpt_neo"
+
+    def build_config(self, hf, dtype) -> TransformerConfig:
+        # hf.attention_layers is the expanded per-layer list, e.g.
+        # ['global', 'local', ...]
+        local_flags = tuple(1 if a == "local" else 0 for a in hf.attention_layers)
+        return TransformerConfig(
+            vocab_size=hf.vocab_size,
+            max_seq_len=hf.max_position_embeddings,
+            num_layers=hf.num_layers,
+            num_heads=hf.num_heads,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size or 4 * hf.hidden_size,
+            pos_emb="learned",
+            activation=_map_activation(getattr(hf, "activation_function", "gelu_new")),
+            layernorm_epsilon=hf.layer_norm_epsilon,
+            tie_embeddings=True,
+            local_attn_window=hf.window_size,
+            local_attn_layers=local_flags if any(local_flags) else None,
+            dtype=dtype,
+        )
+
+    def convert(self, hf, sd, dtype):
+        cfg = self.build_config(hf, dtype)
+        H, Dh, d = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+        p = {k: _t2np(v) for k, v in sd.items()}
+        pre = "transformer." if any(k.startswith("transformer.") for k in p) else ""
+        scale = float(np.sqrt(Dh))  # undo the family's 1/sqrt(Dh) scaling
+        layers = []
+        zeros_hd = np.zeros((H, Dh), np.float32)
+        for i in range(cfg.num_layers):
+            b = f"{pre}h.{i}."
+            lp = {
+                "ln1_scale": p[b + "ln_1.weight"],
+                "ln1_bias": p[b + "ln_1.bias"],
+                "ln2_scale": p[b + "ln_2.weight"],
+                "ln2_bias": p[b + "ln_2.bias"],
+                "wq": (p[b + "attn.attention.q_proj.weight"].T * scale).reshape(d, H, Dh),
+                "wk": p[b + "attn.attention.k_proj.weight"].T.reshape(d, H, Dh),
+                "wv": p[b + "attn.attention.v_proj.weight"].T.reshape(d, H, Dh),
+                "bq": zeros_hd, "bk": zeros_hd, "bv": zeros_hd,
+                "wo": p[b + "attn.attention.out_proj.weight"].T.reshape(H, Dh, d),
+                "bo": p[b + "attn.attention.out_proj.bias"],
+                "wi": p[b + "mlp.c_fc.weight"].T,
+                "bi": p[b + "mlp.c_fc.bias"],
+                "wo_mlp": p[b + "mlp.c_proj.weight"].T,
+                "bo_mlp": p[b + "mlp.c_proj.bias"],
+            }
+            layers.append(lp)
+        params = {
+            "wte": p[pre + "wte.weight"],
+            "wpe": p[pre + "wpe.weight"],
+            "layers": _stack(layers),
+            "lnf_scale": p[pre + "ln_f.weight"],
+            "lnf_bias": p[pre + "ln_f.bias"],
+        }
+        return cfg, params
+
+
+class HFBertLayerPolicy(DSPolicy):
+    """BertModel (reference replace_policy.py:66): bidirectional post-LN
+    encoder. Token-type embedding row 0 is folded into the word embeddings
+    (exact for single-segment inputs); the pooler is not converted — use
+    ``apply(..., return_hidden=True)`` for features."""
+
+    model_type = "bert"
+
+    def build_config(self, hf, dtype) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=hf.vocab_size,
+            max_seq_len=hf.max_position_embeddings,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            pos_emb="learned",
+            activation=_map_activation(getattr(hf, "hidden_act", "gelu")),
+            layernorm_epsilon=hf.layer_norm_eps,
+            causal=False,
+            norm_style="post",
+            embed_ln=True,
+            final_ln=False,
+            tie_embeddings=True,
+            dtype=dtype,
+        )
+
+    def convert(self, hf, sd, dtype):
+        cfg = self.build_config(hf, dtype)
+        H, Dh, d = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+        p = {k: _t2np(v) for k, v in sd.items()}
+        pre = "bert." if any(k.startswith("bert.") for k in p) else ""
+        emb = pre + "embeddings."
+        layers = []
+        for i in range(cfg.num_layers):
+            b = f"{pre}encoder.layer.{i}."
+            lp = {
+                # post-LN: ln1 = post-attention LN, ln2 = post-FFN LN
+                "ln1_scale": p[b + "attention.output.LayerNorm.weight"],
+                "ln1_bias": p[b + "attention.output.LayerNorm.bias"],
+                "ln2_scale": p[b + "output.LayerNorm.weight"],
+                "ln2_bias": p[b + "output.LayerNorm.bias"],
+                "wq": p[b + "attention.self.query.weight"].T.reshape(d, H, Dh),
+                "wk": p[b + "attention.self.key.weight"].T.reshape(d, H, Dh),
+                "wv": p[b + "attention.self.value.weight"].T.reshape(d, H, Dh),
+                "bq": p[b + "attention.self.query.bias"].reshape(H, Dh),
+                "bk": p[b + "attention.self.key.bias"].reshape(H, Dh),
+                "bv": p[b + "attention.self.value.bias"].reshape(H, Dh),
+                "wo": p[b + "attention.output.dense.weight"].T.reshape(H, Dh, d),
+                "bo": p[b + "attention.output.dense.bias"],
+                "wi": p[b + "intermediate.dense.weight"].T,
+                "bi": p[b + "intermediate.dense.bias"],
+                "wo_mlp": p[b + "output.dense.weight"].T,
+                "bo_mlp": p[b + "output.dense.bias"],
+            }
+            layers.append(lp)
+        # fold segment-0 token-type embedding into the word table
+        wte = p[emb + "word_embeddings.weight"] + p[emb + "token_type_embeddings.weight"][0]
+        params = {
+            "wte": wte,
+            "wpe": p[emb + "position_embeddings.weight"],
+            "emb_ln_scale": p[emb + "LayerNorm.weight"],
+            "emb_ln_bias": p[emb + "LayerNorm.bias"],
+            "layers": _stack(layers),
+            "lnf_scale": np.ones((d,), np.float32),  # final_ln=False: unused
+            "lnf_bias": np.zeros((d,), np.float32),
+        }
+        return cfg, params
+
+
 ALL_POLICIES = [
     HFGPT2LayerPolicy,
     HFOPTLayerPolicy,
     GPTNeoXLayerPolicy,
     BloomLayerPolicy,
     MegatronLayerPolicy,
+    HFGPTJLayerPolicy,
+    HFGPTNeoLayerPolicy,
+    HFBertLayerPolicy,
 ]
 
 

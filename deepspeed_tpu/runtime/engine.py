@@ -389,9 +389,18 @@ class DeepSpeedEngine:
             {"elasticity": el}, world_size=self.dp_world
         )
         if el.get("ignore_non_elastic_batch_info", False):
+            # the elastic solution REPLACES the configured sizes (reference
+            # config.py elasticity override), it is not merely advisory
+            self.train_batch_size = final_batch
+            self.micro_batch_size = micro
+            self.gradient_accumulation_steps = max(1, final_batch // (micro * self.dp_world))
+            self.config.train_batch_size = final_batch
+            self.config.train_micro_batch_size_per_gpu = micro
+            self.config.gradient_accumulation_steps = self.gradient_accumulation_steps
             log_dist(
-                f"elasticity: ignoring configured batch sizes; elastic solution "
-                f"is train={final_batch}, micro={micro} for world {self.dp_world}",
+                f"elasticity: overriding configured batch sizes with the elastic "
+                f"solution train={final_batch}, micro={micro}, "
+                f"gas={self.gradient_accumulation_steps} for world {self.dp_world}",
                 ranks=[0],
             )
             return

@@ -59,7 +59,6 @@ def sparse_all_reduce(st: SparseTensor, axis) -> SparseTensor:
     idx = lax.all_gather(st.indices, axis, tiled=True)
     vals = lax.all_gather(st.values, axis, tiled=True)
     counts = lax.all_gather(st.count, axis)  # [world]
-    count = jnp.sum(counts)
     # gathered blocks are [world * N]; each block's valid rows are its prefix,
     # so zero padded rows' values (they would otherwise scatter garbage)
     n = st.indices.shape[0]
