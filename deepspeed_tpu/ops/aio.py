@@ -1,0 +1,144 @@
+"""Python bindings for the native async-IO engine (csrc/aio/dstpu_aio.cpp).
+
+Reference surface: the ``aio_handle`` pybind class
+(csrc/aio/py_lib/py_ds_aio.cpp:12-40 — pread/pwrite/sync_pread/sync_pwrite/
+async_pread/async_pwrite/wait) behind the ``async_io`` op builder. Here the
+C++ library exports a C ABI and this module binds it with ctypes (no pybind
+in the image); the .so is built on first use with g++ and cached in
+``build/`` (the op_builder JIT-load pattern, op_builder/builder.py:472).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_BUILD_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_BUILD_ERROR: Optional[str] = None
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_library() -> Optional[ctypes.CDLL]:
+    global _LIB, _BUILD_ERROR
+    with _BUILD_LOCK:
+        if _LIB is not None or _BUILD_ERROR is not None:
+            return _LIB
+        src = os.path.join(_repo_root(), "csrc", "aio", "dstpu_aio.cpp")
+        out_dir = os.path.join(_repo_root(), "build")
+        os.makedirs(out_dir, exist_ok=True)
+        so = os.path.join(out_dir, "libdstpu_aio.so")
+        try:
+            if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so, src,
+                     "-lpthread"],
+                    check=True, capture_output=True, text=True,
+                )
+            lib = ctypes.CDLL(so)
+        except (OSError, subprocess.CalledProcessError, FileNotFoundError) as e:
+            _BUILD_ERROR = getattr(e, "stderr", None) or str(e)
+            return None
+        lib.dstpu_aio_new.restype = ctypes.c_void_p
+        lib.dstpu_aio_new.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.dstpu_aio_free.argtypes = [ctypes.c_void_p]
+        for name in ("dstpu_aio_submit_read", "dstpu_aio_submit_write"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_int64, ctypes.c_int64]
+        lib.dstpu_aio_wait.restype = ctypes.c_int
+        lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.dstpu_aio_wait_all.restype = ctypes.c_int
+        lib.dstpu_aio_wait_all.argtypes = [ctypes.c_void_p]
+        for name in ("dstpu_aio_pread", "dstpu_aio_pwrite"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_int64, ctypes.c_int64]
+        _LIB = lib
+        return _LIB
+
+
+def aio_available() -> bool:
+    """Compatibility probe (env_report / test gating — the reference's
+    ``is_compatible`` pattern, op_builder/builder.py)."""
+    return _build_library() is not None
+
+
+def build_error() -> Optional[str]:
+    _build_library()
+    return _BUILD_ERROR
+
+
+class AsyncIOHandle:
+    """The reference ``aio_handle`` surface over the ctypes ABI.
+
+    Buffers are numpy arrays (C-contiguous); async ops return integer
+    tickets redeemed by ``wait``.
+    """
+
+    def __init__(self, n_threads: int = 4, use_odirect: bool = False):
+        lib = _build_library()
+        if lib is None:
+            raise RuntimeError(f"dstpu_aio unavailable: {_BUILD_ERROR}")
+        self._lib = lib
+        self._h = lib.dstpu_aio_new(n_threads, int(use_odirect))
+
+    def close(self):
+        if self._h:
+            self._lib.dstpu_aio_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - gc timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @staticmethod
+    def _bufptr(arr: np.ndarray):
+        assert arr.flags["C_CONTIGUOUS"], "aio buffers must be C-contiguous"
+        return arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
+
+    # -- synchronous ----------------------------------------------------------
+    def pread(self, path: str, buf: np.ndarray, offset: int = 0) -> None:
+        ptr, n = self._bufptr(buf)
+        rc = self._lib.dstpu_aio_pread(self._h, path.encode(), ptr, n, offset)
+        if rc != 0:
+            raise OSError(f"aio pread failed: {path}")
+
+    def pwrite(self, path: str, buf: np.ndarray, offset: int = 0) -> None:
+        ptr, n = self._bufptr(buf)
+        rc = self._lib.dstpu_aio_pwrite(self._h, path.encode(), ptr, n, offset)
+        if rc != 0:
+            raise OSError(f"aio pwrite failed: {path}")
+
+    sync_pread = pread
+    sync_pwrite = pwrite
+
+    # -- asynchronous ---------------------------------------------------------
+    def async_pread(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        ptr, n = self._bufptr(buf)
+        return self._lib.dstpu_aio_submit_read(self._h, path.encode(), ptr, n, offset)
+
+    def async_pwrite(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        ptr, n = self._bufptr(buf)
+        return self._lib.dstpu_aio_submit_write(self._h, path.encode(), ptr, n, offset)
+
+    def wait(self, ticket: Optional[int] = None) -> None:
+        rc = (
+            self._lib.dstpu_aio_wait_all(self._h)
+            if ticket is None
+            else self._lib.dstpu_aio_wait(self._h, ticket)
+        )
+        if rc != 0:
+            raise OSError(f"aio wait reported failure (rc={rc})")

@@ -1,0 +1,23 @@
+"""Native-op availability probes (the reference's op_builder compatibility
+report surface, op_builder/__init__.py ALL_OPS + builder.is_compatible)."""
+
+from __future__ import annotations
+
+
+def aio_available() -> bool:
+    """csrc/aio/dstpu_aio.cpp built + loadable (ZeRO-Infinity NVMe tier)."""
+    from .aio import aio_available as _avail
+
+    return _avail()
+
+
+def cpu_adam_available() -> bool:
+    """Host-tier optimizer path (reference csrc/adam/cpu_adam.cpp). On TPU
+    the host Adam is the engine's compute_on('device_host') region, so the
+    probe is for that facility rather than an AVX kernel build."""
+    try:
+        from jax.experimental.compute_on import compute_on  # noqa: F401
+
+        return True
+    except Exception:
+        return False

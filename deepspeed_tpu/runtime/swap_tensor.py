@@ -1,0 +1,114 @@
+"""NVMe tensor swapping — the ZeRO-Infinity tier (reference:
+runtime/swap_tensor/partitioned_param_swapper.py:35 +
+pipelined_optimizer_swapper.py) over the native async-IO engine
+(csrc/aio/dstpu_aio.cpp via ops/aio.py).
+
+The swapper moves HOST-resident pytrees (e.g. the offloaded optimizer state,
+runtime/engine.py ZeRO-Offload) to NVMe and back, with async writes that
+overlap the next train step — device memory is never involved (jax moves
+host<->HBM separately), so this layer is pure numpy + aio.
+
+Usage:
+    swapper = TensorSwapper(path, n_threads=4)
+    manifest = swapper.swap_out(tree, async_op=True)   # returns immediately
+    ...train...
+    swapper.synchronize()                              # writes durable
+    tree2 = swapper.swap_in(manifest)                  # blocking read
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ..ops.aio import AsyncIOHandle, aio_available
+
+PyTree = Any
+
+
+class TensorSwapper:
+    def __init__(self, swap_dir: str, n_threads: int = 4, use_odirect: bool = False):
+        if not aio_available():
+            from ..ops.aio import build_error
+
+            raise RuntimeError(f"native aio unavailable: {build_error()}")
+        os.makedirs(swap_dir, exist_ok=True)
+        self.swap_dir = swap_dir
+        self.handle = AsyncIOHandle(n_threads=n_threads, use_odirect=use_odirect)
+        self._seq = 0
+        self._inflight: list[int] = []
+        # numpy buffers must outlive their async writes
+        self._pinned: dict[int, list[np.ndarray]] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def swap_out(self, tree: PyTree, async_op: bool = False) -> dict:
+        """Write every leaf to one file; returns a manifest for swap_in."""
+        with self._lock:
+            sid = self._seq
+            self._seq += 1
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        entries = []
+        bufs = []
+        tickets = []
+        path = os.path.join(self.swap_dir, f"swap{sid:06d}.bin")
+        offset = 0
+        for i, leaf in enumerate(leaves):
+            arr = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+            bufs.append(arr)
+            entries.append(
+                {"offset": offset, "nbytes": arr.nbytes, "dtype": str(arr.dtype),
+                 "shape": list(arr.shape)}
+            )
+            if async_op:
+                tickets.append(self.handle.async_pwrite(path, arr, offset))
+            else:
+                self.handle.pwrite(path, arr, offset)
+            offset += arr.nbytes
+        if async_op:
+            with self._lock:
+                self._inflight.extend(tickets)
+                self._pinned[sid] = bufs
+        manifest = {
+            "path": path,
+            "entries": entries,
+            "treedef": jax.tree_util.tree_structure(tree),
+            "sid": sid,
+        }
+        return manifest
+
+    def synchronize(self) -> None:
+        """Drain all in-flight writes (pipelined_optimizer_swapper's barrier)."""
+        with self._lock:
+            tickets, self._inflight = self._inflight, []
+            pinned_ids = list(self._pinned)
+        for t in tickets:
+            self.handle.wait(t)
+        with self._lock:
+            for sid in pinned_ids:
+                self._pinned.pop(sid, None)
+
+    def swap_in(self, manifest: dict) -> PyTree:
+        leaves = []
+        path = manifest["path"]
+        for e in manifest["entries"]:
+            arr = np.empty(tuple(e["shape"]), dtype=np.dtype(e["dtype"]))
+            if arr.nbytes:
+                self.handle.pread(path, arr, e["offset"])
+            leaves.append(arr)
+        return jax.tree_util.tree_unflatten(manifest["treedef"], leaves)
+
+    def release(self, manifest: dict) -> None:
+        try:
+            os.remove(manifest["path"])
+        except FileNotFoundError:
+            pass
+
+    def close(self):
+        self.synchronize()
+        self.handle.close()

@@ -1,0 +1,72 @@
+"""Native async-IO engine + NVMe tensor swapper (VERDICT r02 coverage rows
+39 + ZeRO-Infinity tier). Reference: csrc/aio/py_lib/py_ds_aio.cpp
+(aio_handle) + runtime/swap_tensor/. Mirrors the reference's test_aio.py
+read/write correctness strategy."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio import AsyncIOHandle, aio_available, build_error
+
+pytestmark = pytest.mark.skipif(
+    not aio_available(), reason=f"native aio unavailable: {build_error()}"
+)
+
+
+def test_sync_roundtrip(tmp_path):
+    h = AsyncIOHandle(n_threads=2)
+    data = np.random.default_rng(0).normal(size=(1024, 64)).astype(np.float32)
+    path = str(tmp_path / "t.bin")
+    h.pwrite(path, data)
+    out = np.empty_like(data)
+    h.pread(path, out)
+    np.testing.assert_array_equal(out, data)
+    h.close()
+
+
+def test_async_overlap_and_offsets(tmp_path):
+    h = AsyncIOHandle(n_threads=4)
+    path = str(tmp_path / "t.bin")
+    parts = [np.full((256,), i, np.int32) for i in range(8)]
+    tickets = [
+        h.async_pwrite(path, p, offset=i * p.nbytes) for i, p in enumerate(parts)
+    ]
+    for t in tickets:
+        h.wait(t)
+    out = np.empty((8 * 256,), np.int32)
+    h.pread(path, out)
+    np.testing.assert_array_equal(out.reshape(8, 256), np.stack(parts))
+    # wait_all with queued reads
+    bufs = [np.empty((256,), np.int32) for _ in range(8)]
+    for i, b in enumerate(bufs):
+        h.async_pread(path, b, offset=i * b.nbytes)
+    h.wait()  # all
+    np.testing.assert_array_equal(np.stack(bufs), np.stack(parts))
+    h.close()
+
+
+def test_read_error_raises(tmp_path):
+    h = AsyncIOHandle()
+    buf = np.empty((16,), np.float32)
+    with pytest.raises(OSError):
+        h.pread(str(tmp_path / "missing.bin"), buf)
+    h.close()
+
+
+def test_tensor_swapper_roundtrip(tmp_path):
+    from deepspeed_tpu.runtime.swap_tensor import TensorSwapper
+
+    tree = {
+        "m": {"w": np.random.default_rng(0).normal(size=(64, 32)).astype(np.float32)},
+        "v": {"w": np.random.default_rng(1).normal(size=(64, 32)).astype(np.float32)},
+        "step": np.asarray(7, np.int32),
+    }
+    sw = TensorSwapper(str(tmp_path / "swap"))
+    man = sw.swap_out(tree, async_op=True)
+    sw.synchronize()
+    back = sw.swap_in(man)
+    np.testing.assert_array_equal(back["m"]["w"], tree["m"]["w"])
+    np.testing.assert_array_equal(back["v"]["w"], tree["v"]["w"])
+    assert int(back["step"]) == 7
+    sw.release(man)
+    sw.close()
