@@ -36,20 +36,19 @@ struct Task {
   int64_t offset;
 };
 
-// One I/O: open -> full pread/pwrite loop -> close. Returns 0 on success.
-int do_io(const Task &t, bool use_odirect) {
+// One I/O attempt: open -> full pread/pwrite loop -> close. 0 on success.
+// A short READ (EOF before the buffer is full) is an error too: callers
+// always know the exact byte count, so a truncated swap file must surface
+// instead of leaving uninitialized tail bytes. Durability (fsync) is a
+// separate explicit barrier (dstpu_aio_fsync) so N tasks on one file don't
+// serialize on N flushes.
+int do_io_once(const Task &t, bool odirect) {
   int flags = t.write ? (O_WRONLY | O_CREAT) : O_RDONLY;
 #ifdef O_DIRECT
-  if (use_odirect)
+  if (odirect)
     flags |= O_DIRECT;
 #endif
   int fd = ::open(t.path.c_str(), flags, 0644);
-#ifdef O_DIRECT
-  if (fd < 0 && use_odirect) { // filesystem may refuse O_DIRECT; retry buffered
-    flags &= ~O_DIRECT;
-    fd = ::open(t.path.c_str(), flags, 0644);
-  }
-#endif
   if (fd < 0)
     return -1;
   char *p = static_cast<char *>(t.buf);
@@ -66,10 +65,18 @@ int do_io(const Task &t, bool use_odirect) {
     off += n;
     left -= n;
   }
-  if (t.write)
-    ::fsync(fd);
   ::close(fd);
-  return (t.write && left != 0) ? -1 : 0;
+  return (left != 0) ? -1 : 0;
+}
+
+int do_io(const Task &t, bool use_odirect) {
+  if (use_odirect) {
+    // O_DIRECT can fail at open() (fs refuses) OR at pread/pwrite (EINVAL on
+    // unaligned buffer/size/offset); either way fall back to buffered.
+    if (do_io_once(t, true) == 0)
+      return 0;
+  }
+  return do_io_once(t, false);
 }
 
 struct Handle {
@@ -100,6 +107,8 @@ struct Handle {
   }
 
   // Blocks until the ticket completes; returns its status (0 ok, -1 error).
+  // A ticket already drained by wait_all reports success — its failure would
+  // have surfaced in that wait_all's return value.
   int wait(int64_t ticket) {
     std::unique_lock<std::mutex> lk(mu);
     done_cv.wait(lk, [&] {
@@ -108,7 +117,7 @@ struct Handle {
     });
     auto it = pending.find(ticket);
     if (it == pending.end())
-      return -2; // unknown ticket
+      return 0; // drained earlier (wait_all)
     int st = it->second == 0 ? 0 : -1;
     pending.erase(it);
     return st;
@@ -200,6 +209,18 @@ int dstpu_aio_pwrite(void *h, const char *path, void *buf, int64_t size,
                      int64_t offset) {
   Handle *hd = static_cast<Handle *>(h);
   return hd->wait(hd->submit(true, path, buf, size, offset));
+}
+
+// Durability barrier: one fsync per file, called by the host after draining
+// the writes it cares about (pipelined_optimizer_swapper semantics). fsync
+// failure (ENOSPC/EIO) is reported, not swallowed.
+int dstpu_aio_fsync(const char *path) {
+  int fd = ::open(path, O_RDWR);
+  if (fd < 0)
+    return -1;
+  int rc = ::fsync(fd);
+  ::close(fd);
+  return rc == 0 ? 0 : -1;
 }
 
 } // extern "C"

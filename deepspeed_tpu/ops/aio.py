@@ -59,6 +59,8 @@ def _build_library() -> Optional[ctypes.CDLL]:
         lib.dstpu_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.dstpu_aio_wait_all.restype = ctypes.c_int
         lib.dstpu_aio_wait_all.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_fsync.restype = ctypes.c_int
+        lib.dstpu_aio_fsync.argtypes = [ctypes.c_char_p]
         for name in ("dstpu_aio_pread", "dstpu_aio_pwrite"):
             fn = getattr(lib, name)
             fn.restype = ctypes.c_int
@@ -106,7 +108,10 @@ class AsyncIOHandle:
 
     @staticmethod
     def _bufptr(arr: np.ndarray):
-        assert arr.flags["C_CONTIGUOUS"], "aio buffers must be C-contiguous"
+        if not arr.flags["C_CONTIGUOUS"]:
+            # a raw ValueError, not assert: under python -O a view's base
+            # pointer + the view's nbytes would reach C and corrupt memory
+            raise ValueError("aio buffers must be C-contiguous numpy arrays")
         return arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
 
     # -- synchronous ----------------------------------------------------------
@@ -142,3 +147,9 @@ class AsyncIOHandle:
         )
         if rc != 0:
             raise OSError(f"aio wait reported failure (rc={rc})")
+
+    def fsync(self, path: str) -> None:
+        """Durability barrier for one file (writes go through the page cache;
+        per-task fsync would serialize the async pipeline)."""
+        if self._lib.dstpu_aio_fsync(path.encode()) != 0:
+            raise OSError(f"fsync failed: {path}")

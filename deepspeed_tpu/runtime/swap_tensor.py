@@ -18,10 +18,9 @@ Usage:
 
 from __future__ import annotations
 
-import json
 import os
 import threading
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import numpy as np
@@ -44,6 +43,7 @@ class TensorSwapper:
         self._inflight: list[int] = []
         # numpy buffers must outlive their async writes
         self._pinned: dict[int, list[np.ndarray]] = {}
+        self._dirty_paths: set[str] = set()
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------------
@@ -74,6 +74,9 @@ class TensorSwapper:
             with self._lock:
                 self._inflight.extend(tickets)
                 self._pinned[sid] = bufs
+                self._dirty_paths.add(path)
+        else:
+            self.handle.fsync(path)
         manifest = {
             "path": path,
             "entries": entries,
@@ -83,12 +86,17 @@ class TensorSwapper:
         return manifest
 
     def synchronize(self) -> None:
-        """Drain all in-flight writes (pipelined_optimizer_swapper's barrier)."""
+        """Drain all in-flight writes and fsync their files — the durability
+        barrier (pipelined_optimizer_swapper semantics: one fsync per file at
+        the barrier, not one per task)."""
         with self._lock:
             tickets, self._inflight = self._inflight, []
             pinned_ids = list(self._pinned)
+            dirty, self._dirty_paths = self._dirty_paths, set()
         for t in tickets:
             self.handle.wait(t)
+        for p in dirty:
+            self.handle.fsync(p)
         with self._lock:
             for sid in pinned_ids:
                 self._pinned.pop(sid, None)

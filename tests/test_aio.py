@@ -45,6 +45,26 @@ def test_async_overlap_and_offsets(tmp_path):
     h.close()
 
 
+def test_short_read_is_an_error(tmp_path):
+    # a truncated swap file must raise, not return a half-filled buffer
+    h = AsyncIOHandle()
+    small = np.arange(16, dtype=np.float32)
+    path = str(tmp_path / "small.bin")
+    h.pwrite(path, small)
+    big = np.empty((64,), np.float32)
+    with pytest.raises(OSError):
+        h.pread(path, big)
+    h.close()
+
+
+def test_noncontiguous_buffer_rejected(tmp_path):
+    h = AsyncIOHandle()
+    arr = np.zeros((8, 8), np.float32)[:, ::2]  # non-contiguous view
+    with pytest.raises(ValueError, match="contiguous"):
+        h.pwrite(str(tmp_path / "x.bin"), arr)
+    h.close()
+
+
 def test_read_error_raises(tmp_path):
     h = AsyncIOHandle()
     buf = np.empty((16,), np.float32)
