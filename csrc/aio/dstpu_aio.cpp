@@ -23,6 +23,7 @@
 #include <thread>
 #include <unistd.h>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -106,18 +107,27 @@ struct Handle {
     return ticket;
   }
 
-  // Blocks until the ticket completes; returns its status (0 ok, -1 error).
-  // A ticket already drained by wait_all reports success — its failure would
-  // have surfaced in that wait_all's return value.
+  // Blocks until the ticket completes; returns its status (0 ok, -1 error,
+  // -2 never submitted). Failures survive a wait_all drain: that path moves
+  // them into drained_failed so each failed ticket still reports -1 to its
+  // own waiter exactly once.
   int wait(int64_t ticket) {
     std::unique_lock<std::mutex> lk(mu);
+    if (ticket <= 0 || ticket >= next_ticket)
+      return -2;
     done_cv.wait(lk, [&] {
       auto it = pending.find(ticket);
       return it == pending.end() || it->second != 1;
     });
     auto it = pending.find(ticket);
-    if (it == pending.end())
-      return 0; // drained earlier (wait_all)
+    if (it == pending.end()) {
+      auto f = drained_failed.find(ticket);
+      if (f != drained_failed.end()) {
+        drained_failed.erase(f);
+        return -1;
+      }
+      return 0; // drained earlier by wait_all, successfully
+    }
     int st = it->second == 0 ? 0 : -1;
     pending.erase(it);
     return st;
@@ -133,8 +143,10 @@ struct Handle {
     });
     int st = 0;
     for (auto &kv : pending)
-      if (kv.second != 0)
+      if (kv.second != 0) {
         st = -1;
+        drained_failed.insert(kv.first);
+      }
     pending.clear();
     return st;
   }
@@ -165,6 +177,7 @@ private:
   std::condition_variable cv, done_cv;
   std::deque<Task> queue;
   std::unordered_map<int64_t, int> pending; // 1 in-flight, 0 ok, 2 error
+  std::unordered_set<int64_t> drained_failed; // failures drained by wait_all
   std::vector<std::thread> workers;
   int64_t next_ticket;
   bool stopping;

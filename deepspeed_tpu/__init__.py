@@ -15,6 +15,7 @@ __git_branch__ = "main"
 
 from .runtime.config import DeepSpeedConfig
 from .runtime.engine import DeepSpeedEngine
+from .runtime import activation_checkpointing as checkpointing  # noqa: F401
 from .utils.logging import log_dist, logger
 from . import comm
 

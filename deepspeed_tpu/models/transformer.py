@@ -80,6 +80,19 @@ class TransformerConfig:
     #   "dots_and_flash"  — dots_saveable + the flash residuals: no matmul or
     #                       attention recompute, memory = all matmul outputs
     remat_policy: str = "save_flash"
+    # Activation-checkpointing extensions (reference configure() knobs,
+    # runtime/activation_checkpointing/checkpointing.py:825):
+    #   remat_offload        — cpu_checkpointing: saved layer-boundary
+    #                          activations live in pinned host memory
+    #   remat_partition_axis — partition_activations: saved boundaries are
+    #                          sharded over this mesh axis (e.g. "model");
+    #                          recompute all-gathers them (memory↔comm trade)
+    #   remat_group          — layers per checkpoint group; number_checkpoints
+    #                          = num_layers // remat_group. >1 saves
+    #                          boundaries only at group edges.
+    remat_offload: bool = False
+    remat_partition_axis: str = ""
+    remat_group: int = 0
     dtype: Any = jnp.float32  # compute dtype (params always stored fp32)
     moe_every: int = 0  # >0: every Nth layer is an MoE FFN (see moe/)
     num_experts: int = 1
@@ -286,15 +299,72 @@ def xla_attention(q, k, v, *, causal_offset=0, bias=None, causal=True, dtype=jnp
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _remat_policy(name: str):
-    """Resolve a remat-policy name (TransformerConfig.remat_policy)."""
+_SAVED_NAMES = {"save_flash": ("flash_out", "flash_lse"), "nothing_saveable": ()}
+
+
+def _remat_policy(name: str, offload: bool = False):
+    """Resolve a remat-policy name (TransformerConfig.remat_policy).
+
+    ``offload=True`` (cpu_checkpointing): the tagged ``layer_in`` boundary
+    residual is saved to pinned host memory instead of HBM — the reference
+    moves the saved input to CPU at checkpoint:493/:480; here XLA schedules
+    the d2h/h2d copies asynchronously around the recompute."""
     cp = jax.checkpoint_policies
+    if offload:
+        saved = _SAVED_NAMES.get(name)
+        if saved is None:
+            raise ValueError(
+                f"cpu_checkpointing composes with named-residual remat policies "
+                f"{sorted(_SAVED_NAMES)}, not {name!r}")
+        return cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=list(saved),
+            names_which_can_be_offloaded=["layer_in"],
+            offload_src="device",
+            offload_dst="pinned_host",
+        )
     flash_names = cp.save_only_these_names("flash_out", "flash_lse")
     if name == "save_flash":
         return flash_names
     if name == "dots_and_flash":
         return cp.save_from_both_policies(cp.dots_saveable, flash_names)
     return getattr(cp, name, None)
+
+
+def _boundary_tagger(cfg: TransformerConfig):
+    """Per-layer boundary treatment for activation checkpointing.
+
+    Tags the residual-stream carry as ``layer_in`` (so offload policies can
+    target it) and, under partition_activations, stores the saved copy sharded
+    over ``remat_partition_axis`` — the reference slices the saved input
+    across TP ranks (checkpointing.py:367) and all-gathers on recompute; the
+    sharding-constraint pair expresses the same trade to XLA."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    axis = cfg.remat_partition_axis
+    needs_tag = cfg.remat and (cfg.remat_offload or bool(axis))
+    if not needs_tag:
+        return lambda x: x
+    U = jax.sharding.PartitionSpec.UNCONSTRAINED
+
+    def tag(x):
+        mesh = _ACTIVE_MESH[0]
+        use_axis = (
+            axis
+            and mesh is not None
+            and mesh.shape.get(axis, 1) > 1
+            and x.ndim == 3
+            and x.shape[1] % mesh.shape[axis] == 0
+        )
+        if use_axis:
+            x = jax.lax.with_sharding_constraint(
+                x, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(U, axis, U)))
+        x = checkpoint_name(x, "layer_in")
+        if use_axis:
+            x = jax.lax.with_sharding_constraint(
+                x, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(U, None, U)))
+        return x
+
+    return tag
 
 
 def _attention_dispatch(cfg: TransformerConfig):
@@ -568,10 +638,15 @@ def apply(
             depth_frac = jnp.arange(L, dtype=jnp.float32) / max(1, L)
             layers_xs["_pld_keep"] = 1.0 - depth_frac * (1.0 - theta_t)  # [L]
 
+    tag = _boundary_tagger(cfg)
+
     def scan_body(carry, lp):
         return body(carry, lp)
 
-    policy = _remat_policy(cfg.remat_policy) if cfg.remat else None
+    def tagged_body(carry, lp):
+        return body(tag(carry), lp)
+
+    policy = _remat_policy(cfg.remat_policy, offload=cfg.remat_offload) if cfg.remat else None
 
     def maybe_remat(f):
         return jax.checkpoint(f, policy=policy, prevent_cse=False) if cfg.remat else f
@@ -587,7 +662,7 @@ def apply(
 
         def group_body(carry, xs):
             lg, moe_p = xs
-            x = carry
+            x = tag(carry)
             if E > 1:
                 dense_part = jax.tree.map(lambda a: a[: E - 1], lg)
                 x, _ = lax.scan(scan_body, x, dense_part)
@@ -608,7 +683,27 @@ def apply(
             else:
                 x, _ = body(x, lp)
     else:
-        x, _ = lax.scan(maybe_remat(scan_body), x, layers_xs)
+        Gsz = cfg.remat_group
+        if cfg.remat and Gsz and Gsz > 1 and L % Gsz != 0:
+            import warnings
+
+            warnings.warn(
+                f"remat_group={Gsz} does not divide num_layers={L}; "
+                "falling back to per-layer activation checkpointing")
+        if cfg.remat and Gsz and Gsz > 1 and L % Gsz == 0:
+            # number_checkpoints analogue (reference checkpoint():743 with
+            # num_checkpoints < num_layers): boundaries saved only every Gsz
+            # layers; the whole group recomputes in backward.
+            layers_gr = jax.tree.map(
+                lambda a: a.reshape((L // Gsz, Gsz) + a.shape[1:]), layers_xs)
+
+            def remat_group_body(carry, lg):
+                x, _ = lax.scan(scan_body, tag(carry), lg)
+                return x, None
+
+            x, _ = lax.scan(maybe_remat(remat_group_body), x, layers_gr)
+        else:
+            x, _ = lax.scan(maybe_remat(tagged_body), x, layers_xs)
 
     if cfg.final_ln:
         x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon)

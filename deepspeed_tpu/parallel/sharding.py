@@ -65,11 +65,20 @@ def spec_from_logical(
     shape: tuple[int, ...],
     rules: Rules,
     mesh: Mesh,
+    zero_fallback: MeshAxes = None,
 ) -> PartitionSpec:
     """Map one parameter's logical axes to a PartitionSpec, skipping any mesh
     axis that does not divide the dimension (reference analogue: padding of
     the flat partition buffers, stage_1_and_2.py:562 — we instead replicate
-    non-divisible dims, which XLA handles without padding)."""
+    non-divisible dims, which XLA handles without padding).
+
+    ``zero_fallback``: ZeRO axes that MUST land somewhere if possible. The
+    reference's flat-buffer partitioning shards *every* tensor's optimizer
+    state across DP ranks regardless of its shape (stage_1_and_2.py:93); the
+    rule table alone can miss leaves whose logical axes carry no ZeRO rule
+    (attention biases, per-head scales). When none of the fallback axes were
+    placed by the rules, the largest still-unsharded divisible dim takes them.
+    """
     if logical_axes is None:
         return PartitionSpec()
     assert len(logical_axes) == len(shape), f"{logical_axes} vs {shape}"
@@ -90,6 +99,18 @@ def spec_from_logical(
             out.append(axes if len(axes) > 1 else axes[0])
         else:
             out.append(None)
+    if zero_fallback is not None:
+        fb = (zero_fallback,) if isinstance(zero_fallback, str) else tuple(zero_fallback)
+        fb = tuple(a for a in fb if a in mesh.shape and a not in used)
+        size = int(np.prod([mesh.shape[a] for a in fb])) if fb else 1
+        if fb and size > 1:
+            candidates = [
+                (shape[i], i) for i in range(len(shape))
+                if out[i] is None and shape[i] % size == 0 and shape[i] >= size
+            ]
+            if candidates:
+                _, i = max(candidates)
+                out[i] = fb if len(fb) > 1 else fb[0]
     while out and out[-1] is None:
         out.pop()
     return PartitionSpec(*out)

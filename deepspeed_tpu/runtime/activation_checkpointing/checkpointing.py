@@ -1,0 +1,201 @@
+"""Activation checkpointing — the ``deepspeed.checkpointing`` API, TPU-native.
+
+Reference surface (runtime/activation_checkpointing/checkpointing.py):
+``configure()`` (:825) sets global knobs from config; ``checkpoint(fn, *args)``
+(:743) wraps a forward segment in selective recompute, with options to slice
+the saved inputs across TP ranks (``partition_activations``, :367), move them
+to CPU (``checkpoint_in_cpu``, :480), and track CUDA RNG states so dropout
+replays identically (:122).
+
+TPU-native mapping — each knob becomes a property of the *compiled program*
+rather than runtime buffer juggling:
+
+- recompute          → ``jax.checkpoint`` (remat) with a policy
+- partition_activations → the saved boundary value is stored sharded over the
+  TP mesh axis (sharding-constraint pair around ``checkpoint_name``); XLA
+  all-gathers it for the recompute, the same memory↔comm trade
+- checkpoint_in_cpu  → ``save_and_offload_only_these_names`` policy: the
+  tagged boundary is written to pinned host memory, streamed back in backward
+- num_checkpoints    → checkpoint-group size over the layer scan
+  (``TransformerConfig.remat_group``)
+- RNG tracking       → unnecessary by construction: JAX PRNG keys are explicit
+  function arguments, so a remat'd segment replays dropout bit-identically;
+  ``get_rng_tracker()`` exists for API compat and documents this.
+- contiguous_memory_optimization / synchronize_checkpoint_boundary → XLA owns
+  buffer layout and scheduling; accepted and recorded, nothing to do.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+from typing import Any, Callable, Optional
+
+import jax
+
+from ...utils.logging import logger
+from ..config import ActivationCheckpointingConfig
+
+_config: Optional[ActivationCheckpointingConfig] = None
+
+
+def configure(
+    mpu_=None,
+    deepspeed_config: Optional[dict] = None,
+    partition_activations: Optional[bool] = None,
+    contiguous_checkpointing: Optional[bool] = None,
+    num_checkpoints: Optional[int] = None,
+    checkpoint_in_cpu: Optional[bool] = None,
+    synchronize: Optional[bool] = None,
+    profile: Optional[bool] = None,
+) -> ActivationCheckpointingConfig:
+    """Set global activation-checkpointing behavior (reference :825).
+
+    Explicit kwargs override ``deepspeed_config["activation_checkpointing"]``.
+    ``mpu_`` is accepted for signature parity; the TP axis comes from the
+    active mesh, not an mpu object.
+    """
+    global _config
+    base = {}
+    if deepspeed_config:
+        base = dict(deepspeed_config.get("activation_checkpointing", {}))
+    overrides = {
+        "partition_activations": partition_activations,
+        "contiguous_memory_optimization": contiguous_checkpointing,
+        "number_checkpoints": num_checkpoints,
+        "cpu_checkpointing": checkpoint_in_cpu,
+        "synchronize_checkpoint_boundary": synchronize,
+        "profile": profile,
+    }
+    for k, v in overrides.items():
+        if v is not None:
+            base[k] = v
+    base.setdefault("enabled", True)
+    known = {f for f in ActivationCheckpointingConfig.__dataclass_fields__}
+    _config = ActivationCheckpointingConfig(**{k: v for k, v in base.items() if k in known})
+    if _config.contiguous_memory_optimization or _config.synchronize_checkpoint_boundary:
+        logger.info(
+            "activation_checkpointing: contiguous_memory_optimization / "
+            "synchronize_checkpoint_boundary are XLA-managed on TPU (buffer "
+            "assignment + async scheduling); accepted as no-ops")
+    return _config
+
+
+def set_config(cfg: ActivationCheckpointingConfig) -> None:
+    """Install an already-parsed config (engine path)."""
+    global _config
+    _config = cfg
+
+
+def is_configured() -> bool:
+    return _config is not None
+
+
+def get_config() -> ActivationCheckpointingConfig:
+    return _config if _config is not None else ActivationCheckpointingConfig()
+
+
+def reset() -> None:
+    global _config
+    _config = None
+
+
+def model_overrides(num_layers: int) -> dict[str, Any]:
+    """Translate the configured knobs into TransformerConfig fields
+    (consumed by the engine when wiring a model)."""
+    cfg = get_config()
+    if not cfg.enabled:
+        return {}
+    out: dict[str, Any] = {"remat": True}
+    if cfg.policy:  # empty = keep the model's tuned default (save_flash)
+        out["remat_policy"] = cfg.policy
+    if cfg.cpu_checkpointing:
+        out["remat_offload"] = True
+    if cfg.partition_activations:
+        out["remat_partition_axis"] = "model"
+    if cfg.number_checkpoints and 0 < cfg.number_checkpoints < num_layers:
+        if num_layers % cfg.number_checkpoints == 0:
+            out["remat_group"] = num_layers // cfg.number_checkpoints
+        else:
+            logger.warning(
+                "number_checkpoints=%d does not divide num_layers=%d; "
+                "using per-layer checkpointing", cfg.number_checkpoints, num_layers)
+    return out
+
+
+def _policy():
+    cfg = get_config()
+    cp = jax.checkpoint_policies
+    if cfg.cpu_checkpointing:
+        return cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["act_ckpt_input"],
+            offload_src="device",
+            offload_dst="pinned_host",
+        )
+    name = cfg.policy
+    if name in ("", "nothing_saveable"):
+        return None  # jax.checkpoint default: full recompute
+    return getattr(cp, name, None)
+
+
+def checkpoint(function: Callable, *args):
+    """Run ``function(*args)`` under selective recompute (reference :743).
+
+    Unlike the reference this is an ordinary function transform — no autograd
+    Function subclass, no RNG stashing — because ``jax.checkpoint`` replays
+    pure functions exactly.
+    """
+    from jax.ad_checkpoint import checkpoint_name
+
+    cfg = get_config()
+
+    def tagged(*inner):
+        inner = tuple(
+            checkpoint_name(a, "act_ckpt_input")
+            if isinstance(a, jax.Array) or hasattr(a, "aval") else a
+            for a in inner
+        )
+        return function(*inner)
+
+    fn = jax.checkpoint(tagged, policy=_policy(), prevent_cse=False)
+    if cfg.profile:
+        with jax.profiler.TraceAnnotation("act_checkpoint"):
+            return fn(*args)
+    return fn(*args)
+
+
+def checkpoint_wrapped(function: Callable) -> Callable:
+    """Decorator form: ``layer = checkpoint_wrapped(layer)``."""
+    def run(*args):
+        return checkpoint(function, *args)
+    return run
+
+
+class _RngTracker:
+    """API-compat shim for the reference's CudaRNGStatesTracker (:122).
+
+    JAX threads PRNG keys explicitly, so a remat'd region that received key K
+    recomputes dropout with key K — fork-on-entry state snapshots are
+    structurally unnecessary. ``fork()`` is therefore a no-op context."""
+
+    def fork(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def get_states(self):
+        return {}
+
+    def add(self, name, seed):  # pragma: no cover - compat only
+        logger.info("RNG tracker.add(%s) ignored: JAX PRNG keys are explicit", name)
+
+
+_rng_tracker = _RngTracker()
+
+
+def get_rng_tracker() -> _RngTracker:
+    return _rng_tracker
+
+
+def summarize() -> dict:
+    return asdict(get_config())

@@ -135,8 +135,11 @@ class ActivationCheckpointingConfig:
     number_checkpoints: Optional[int] = None
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False
-    # TPU-only: jax.checkpoint policy name (see runtime/checkpointing.py)
-    policy: str = "nothing_saveable"
+    # TPU-only: jax.checkpoint policy name (runtime/activation_checkpointing).
+    # Empty = keep the model's own remat_policy (default save_flash, the
+    # tuned fast path); the generic checkpoint() API treats empty as
+    # nothing_saveable (full recompute).
+    policy: str = ""
     enabled: bool = False
 
 

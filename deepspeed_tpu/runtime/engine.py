@@ -146,6 +146,18 @@ class DeepSpeedEngine:
         self._acknowledge_compiler_managed_knobs(raw)
         self._enforce_elasticity(raw)
 
+        # ---- activation checkpointing (reference checkpointing.py:825
+        # configure(); engine wires the knobs into the model's remat config) --
+        ac = self.config.activation_checkpointing
+        if ac.enabled and hasattr(model, "config") and hasattr(model.config, "replace"):
+            from . import activation_checkpointing as act_ckpt
+
+            act_ckpt.set_config(ac)
+            overrides = act_ckpt.model_overrides(getattr(model.config, "num_layers", 0))
+            if overrides:
+                model.config = model.config.replace(**overrides)
+                logger.info("activation_checkpointing: %s", overrides)
+
         # ---- sharding rules --------------------------------------------------
         zstage = self.config.zero_optimization.stage
         self.zero_stage = zstage
@@ -153,14 +165,21 @@ class DeepSpeedEngine:
         axes_tree = model.logical_axes()
         shapes = jax.eval_shape(lambda r: model.init(r), jax.random.PRNGKey(0))
         shape_tree = jax.tree.map(lambda s: s.shape, shapes)
+        # ZeRO axes must land on every leaf's optimizer state (and, at stage 3,
+        # the param itself) even when the rule table has no match for its
+        # logical axes — the reference's flat-buffer partition shards biases
+        # too (stage_1_and_2.py:93). spec_from_logical's zero_fallback places
+        # them on the largest divisible free dim.
+        zfb = ("fsdp", "data") if zstage >= 1 else None
         self.param_specs = jax.tree.map(
-            lambda ax, shp: shd.spec_from_logical(ax, shp, param_rules, self.mesh),
+            lambda ax, shp: shd.spec_from_logical(
+                ax, shp, param_rules, self.mesh, zero_fallback=zfb if zstage >= 3 else None),
             axes_tree,
             shape_tree,
             is_leaf=lambda x: x is None or (isinstance(x, tuple) and not isinstance(x[0] if x else None, dict)),
         )
         self.opt_specs_for_params = jax.tree.map(
-            lambda ax, shp: shd.spec_from_logical(ax, shp, opt_rules, self.mesh),
+            lambda ax, shp: shd.spec_from_logical(ax, shp, opt_rules, self.mesh, zero_fallback=zfb),
             axes_tree,
             shape_tree,
             is_leaf=lambda x: x is None or (isinstance(x, tuple) and not isinstance(x[0] if x else None, dict)),
@@ -613,13 +632,7 @@ class DeepSpeedEngine:
             }
             return new_state, metrics
 
-        state_shardings = self._state_shardings
-        return jax.jit(
-            train_step,
-            in_shardings=(state_shardings, NamedSharding(mesh, self.batch_spec)),
-            out_shardings=(state_shardings, None),
-            donate_argnums=(0,),
-        )
+        return self._jit_step(train_step, self.batch_spec)
 
     # ------------------------------------------------------------------
     @property
@@ -761,13 +774,23 @@ class DeepSpeedEngine:
             }
             return new_state, metrics
 
-        state_shardings = self._state_shardings
-        return jax.jit(
-            train_step,
-            in_shardings=(state_shardings, NamedSharding(mesh, batch_spec)),
-            out_shardings=(state_shardings, None),
+        return self._jit_step(train_step, batch_spec)
+
+    def _jit_step(self, train_step, batch_spec):
+        """Compile a (state, batch) -> (state, metrics) step with the engine's
+        shardings. With host-offloaded activation checkpoints the program
+        mixes memory kinds; XLA's SPMD partitioner then RET_CHECKs on the
+        placement annotations explicit out_shardings generate
+        (spmd_partitioner.cc:5743 "Side-effect HLO must have sharding"), so
+        that path pins layout via in_shardings + donation only — outputs
+        propagate the same shardings elementwise."""
+        kwargs = dict(
+            in_shardings=(self._state_shardings, NamedSharding(self.mesh, batch_spec)),
             donate_argnums=(0,),
         )
+        if not getattr(getattr(self.model, "config", None), "remat_offload", False):
+            kwargs["out_shardings"] = (self._state_shardings, None)
+        return jax.jit(train_step, **kwargs)
 
     # ------------------------------------------------------------------
     def train_batch(self, batch: dict) -> dict:

@@ -74,8 +74,9 @@ class TensorSwapper:
             with self._lock:
                 self._inflight.extend(tickets)
                 self._pinned[sid] = bufs
-                self._dirty_paths.add(path)
-        else:
+                if leaves:  # empty tree: no write ever created the file
+                    self._dirty_paths.add(path)
+        elif leaves:
             self.handle.fsync(path)
         manifest = {
             "path": path,
@@ -90,16 +91,35 @@ class TensorSwapper:
         barrier (pipelined_optimizer_swapper semantics: one fsync per file at
         the barrier, not one per task)."""
         with self._lock:
-            tickets, self._inflight = self._inflight, []
+            tickets = list(self._inflight)
             pinned_ids = list(self._pinned)
-            dirty, self._dirty_paths = self._dirty_paths, set()
+            dirty = set(self._dirty_paths)
+        # State is cleared only for work that actually completed: if a wait or
+        # fsync raises, the remaining tickets/paths/buffers stay queued so a
+        # retry (or close()) still drains them and no durable-fsync is lost.
+        errors: list[Exception] = []
+        done_tickets: list[int] = []
         for t in tickets:
-            self.handle.wait(t)
+            try:
+                self.handle.wait(t)
+            except OSError as e:
+                errors.append(e)
+            done_tickets.append(t)  # drained either way; failure is recorded
+        synced: set[str] = set()
         for p in dirty:
-            self.handle.fsync(p)
+            try:
+                self.handle.fsync(p)
+                synced.add(p)
+            except OSError as e:
+                errors.append(e)
         with self._lock:
-            for sid in pinned_ids:
-                self._pinned.pop(sid, None)
+            self._inflight = [t for t in self._inflight if t not in done_tickets]
+            self._dirty_paths -= synced
+            if not self._inflight:
+                for sid in pinned_ids:
+                    self._pinned.pop(sid, None)
+        if errors:
+            raise OSError(f"swap synchronize: {len(errors)} failure(s): {errors[0]}")
 
     def swap_in(self, manifest: dict) -> PyTree:
         leaves = []

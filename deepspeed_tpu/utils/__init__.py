@@ -1,0 +1,7 @@
+from .annotate import instrument, instrument_w_nvtx, range_push  # noqa: F401
+from .debug import debug_param_name, extract_param_names, tree_summary  # noqa: F401
+from .flatten import flatten, flatten_pytree, unflatten  # noqa: F401
+from .init_on_device import OnDevice, abstract_init, on_meta  # noqa: F401
+from .logging import log_dist, logger  # noqa: F401
+from .memory import see_memory_usage  # noqa: F401
+from .timer import SynchronizedWallClockTimer, ThroughputTimer  # noqa: F401

@@ -1,0 +1,43 @@
+"""Profiler range annotations — the NVTX analogue on TPU.
+
+The reference decorates hot functions with ``@instrument_w_nvtx``
+(utils/nvtx.py:4) so ranges show up in Nsight. The TPU equivalent is
+``jax.profiler.TraceAnnotation`` / ``annotate_function``: ranges appear in the
+XPlane trace viewed in TensorBoard or Perfetto. On host-only paths (no
+profiler session active) the annotations are free no-ops.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+
+
+def instrument(func: Callable) -> Callable:
+    """Decorator: record ``func``'s wall time as a named profiler range."""
+    name = getattr(func, "__qualname__", getattr(func, "__name__", "fn"))
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(name):
+            return func(*args, **kwargs)
+
+    return wrapped
+
+
+# Name-compatible alias for users porting reference code.
+instrument_w_nvtx = instrument
+
+
+def range_push(name: str):
+    """Open an explicit profiler range; returns an object with ``.pop()``."""
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+
+    class _Range:
+        def pop(self_inner):
+            ann.__exit__(None, None, None)
+
+    return _Range()

@@ -1,0 +1,58 @@
+"""Debug name maps for parameter pytrees.
+
+The reference keeps global id→name maps filled by ``debug_extract_module_and_
+param_names`` (utils/debug.py) so ZeRO hook internals can print human names
+for the flat tensors they shuffle. Here parameters live in a pytree whose
+*paths are already the names*; these helpers render them and build the same
+lookup tables for log lines and tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+param_names: dict[int, str] = {}
+
+
+def path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def extract_param_names(params: Any) -> dict[str, Any]:
+    """name → leaf map; also fills the global id→name table."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = path_str(path)
+        out[name] = leaf
+        param_names[id(leaf)] = name
+    return out
+
+
+def debug_param_name(leaf) -> str:
+    return param_names.get(id(leaf), f"<unnamed {getattr(leaf, 'shape', '?')}>")
+
+
+def tree_summary(params: Any, max_leaves: int = 24) -> str:
+    """Readable shape/dtype/sharding summary of a parameter tree."""
+    lines = []
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in leaves[:max_leaves]:
+        sh = getattr(leaf, "sharding", None)
+        spec = getattr(sh, "spec", "") if sh is not None else ""
+        lines.append(f"{path_str(path):60s} {str(getattr(leaf, 'shape', '?')):>20s} "
+                     f"{str(getattr(leaf, 'dtype', '?')):>10s}  {spec}")
+    if len(leaves) > max_leaves:
+        lines.append(f"... {len(leaves) - max_leaves} more leaves")
+    return "\n".join(lines)

@@ -1,0 +1,38 @@
+"""Flatten / unflatten dense tensor collections.
+
+The reference binds apex's fused ``_flatten_dense_tensors`` /
+``_unflatten_dense_tensors`` as a C++ op (csrc/utils/flatten_unflatten.cpp) to
+build ZeRO's flat fp16 partition buffers. Under XLA a flat view is rarely
+needed (the compiler lays out and fuses buffers itself), but the operation is
+still useful at API boundaries — 1-bit compression, checkpoint consolidation,
+norm computation over a whole pytree — so it is provided as pure jnp.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def flatten(tensors: Sequence[jax.Array]) -> jax.Array:
+    """Concatenate tensors into one contiguous 1-D buffer."""
+    return jnp.concatenate([jnp.ravel(t) for t in tensors]) if tensors else jnp.zeros((0,))
+
+
+def unflatten(flat: jax.Array, like: Sequence[jax.Array]) -> list[jax.Array]:
+    """Split a flat buffer back into tensors shaped like ``like``."""
+    out, off = [], 0
+    for t in like:
+        n = t.size
+        out.append(jax.lax.dynamic_slice_in_dim(flat, off, n).reshape(t.shape).astype(t.dtype))
+        off += n
+    return out
+
+
+def flatten_pytree(tree):
+    """Flatten a whole pytree to (flat_1d_fp32, unravel_fn)."""
+    from jax.flatten_util import ravel_pytree
+
+    return ravel_pytree(tree)

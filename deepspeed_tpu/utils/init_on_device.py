@@ -1,0 +1,88 @@
+"""Abstract ("meta"-device) model materialization.
+
+The reference's ``OnDevice`` context manager (utils/init_on_device.py) patches
+``torch.Tensor.__new__`` so that ``nn.Module`` construction allocates on a
+chosen device — most importantly the ``meta`` device, where tensors carry only
+shape/dtype so a 100B-parameter model can be *described* without allocating.
+
+JAX already separates description from allocation: ``jax.eval_shape`` runs any
+init function with abstract values and returns a pytree of
+``jax.ShapeDtypeStruct``. ``OnDevice`` here wraps that idiom behind the
+reference's API shape so porting users find the same entry point:
+
+    with OnDevice(dtype=jnp.bfloat16, device="meta"):
+        abstract_params = model.init(rng)       # ShapeDtypeStructs, no memory
+
+    # later: materialize directly into the sharded layout (zero.Init analogue)
+    params = materialize(model.init, rng, shardings)
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    """Context manager under which ``capture(fn)(*args)`` returns abstract
+    shapes instead of allocated arrays (``device="meta"``), or allocates on a
+    specific device otherwise.
+
+    Unlike torch there is nothing global to patch: JAX init functions are pure,
+    so the context simply records the requested placement and exposes
+    :meth:`init` to run a function accordingly.
+    """
+
+    _active: Optional["OnDevice"] = None
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = OnDevice._active
+        if self.enabled:
+            OnDevice._active = self
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._active = self._prev
+        return False
+
+    def _cast_tree(self, tree):
+        if self.dtype is None:
+            return tree
+        def cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                if isinstance(x, jax.ShapeDtypeStruct):
+                    return jax.ShapeDtypeStruct(x.shape, self.dtype, sharding=x.sharding)
+                return x.astype(self.dtype)
+            return x
+        return jax.tree.map(cast, tree)
+
+    def init(self, fn: Callable, *args, **kwargs) -> Any:
+        """Run ``fn(*args)`` under this context's placement policy."""
+        if not self.enabled:
+            return self._cast_tree(fn(*args, **kwargs))
+        if self.device == "meta":
+            return self._cast_tree(jax.eval_shape(fn, *args, **kwargs))
+        if self.device == "cpu":
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                return self._cast_tree(fn(*args, **kwargs))
+        return self._cast_tree(fn(*args, **kwargs))
+
+
+def abstract_init(fn: Callable, *args, dtype=None, **kwargs):
+    """Shorthand: shapes/dtypes of ``fn(*args)`` with zero allocation."""
+    return OnDevice(dtype=dtype, device="meta").init(fn, *args, **kwargs)
+
+
+@contextlib.contextmanager
+def on_meta():
+    with OnDevice(device="meta") as ctx:
+        yield ctx

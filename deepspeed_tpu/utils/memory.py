@@ -1,0 +1,58 @@
+"""Memory usage reporting (``see_memory_usage`` analogue).
+
+The reference prints CUDA allocator stats at phase boundaries
+(utils/__init__.py ``see_memory_usage``, called at runtime/engine.py:1606/
+:1757/:1954). On TPU the equivalents are per-device ``memory_stats()``
+(bytes_in_use / peak_bytes_in_use from the TPU runtime) plus host RSS from
+/proc — there is no allocator cache to flush because XLA plans buffers at
+compile time.
+"""
+
+from __future__ import annotations
+
+from .logging import logger
+
+
+def _host_rss_gb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024**2
+    except OSError:
+        pass
+    return 0.0
+
+
+def device_memory_stats(device=None) -> dict:
+    """Per-device memory stats (empty dict when the backend lacks them)."""
+    import jax
+
+    device = device or jax.devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    return stats or {}
+
+
+def see_memory_usage(message: str, force: bool = False) -> dict:
+    """Log current + peak device memory and host RSS; returns the numbers.
+
+    Mirrors the reference's call sites: drop a one-liner at a phase boundary.
+    ``force=False`` matches the reference's gating flag (callers thread a
+    config bit through it).
+    """
+    import jax
+
+    stats = device_memory_stats()
+    used = stats.get("bytes_in_use", 0) / 1024**3
+    peak = stats.get("peak_bytes_in_use", 0) / 1024**3
+    limit = stats.get("bytes_limit", 0) / 1024**3
+    rss = _host_rss_gb()
+    if force or used or peak:
+        logger.info(
+            "%s | device mem: %.2f GB used, %.2f GB peak, %.2f GB limit | host RSS %.2f GB",
+            message, used, peak, limit, rss,
+        )
+    else:
+        logger.info("%s | host RSS %.2f GB (device stats unavailable: %s)",
+                    message, rss, jax.default_backend())
+    return {"used_gb": used, "peak_gb": peak, "limit_gb": limit, "host_rss_gb": rss}

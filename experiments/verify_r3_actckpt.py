@@ -1,0 +1,84 @@
+"""Verify driver: round-3 changes (activation checkpointing knobs, ZeRO
+opt-state fallback sharding, utils) through the public API on the 8-device
+CPU mesh."""
+
+import os
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+rng = np.random.default_rng(0)
+batch = {"tokens": rng.integers(0, 128, size=(16, 33)).astype(np.int32)}
+
+
+def train(ac, steps=6, stage=2):
+    model = Model(TransformerConfig(
+        vocab_size=128, max_seq_len=64, num_layers=4, num_heads=4,
+        hidden_size=64, dtype=jnp.float32))
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "mesh": {"data": -1},
+        "activation_checkpointing": ac,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(steps)]
+    return engine, losses
+
+
+# 1. baseline vs every act-ckpt knob: loss decreases and matches
+_, base = train({"enabled": False})
+assert base[-1] < base[0], base
+for name, ac in [
+    ("remat", {"enabled": True, "policy": "nothing_saveable"}),
+    ("cpu_ckpt", {"enabled": True, "policy": "nothing_saveable", "cpu_checkpointing": True}),
+    ("grouped", {"enabled": True, "policy": "nothing_saveable", "number_checkpoints": 2}),
+]:
+    _, ls = train(ac)
+    np.testing.assert_allclose(base, ls, rtol=3e-5, err_msg=name)
+    print(f"{name}: losses match baseline {ls[0]:.4f} -> {ls[-1]:.4f}")
+
+# 2. opt-state fallback sharding: bias moments take the ZeRO axis
+engine, _ = train({"enabled": False}, steps=1, stage=2)
+for leaf in ("bq", "bi"):
+    spec = str(engine.state["opt"]["m"]["layers"][leaf].sharding.spec)
+    assert "data" in spec or "fsdp" in spec, (leaf, spec)
+print("opt-state bias shards:", spec)
+
+# 3. utils through the public surface
+from deepspeed_tpu.utils import OnDevice, flatten, unflatten, see_memory_usage
+
+with OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+    ab = ctx.init(Model(TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
+                                          hidden_size=32, max_seq_len=32)).init,
+                  jax.random.PRNGKey(0))
+assert all(isinstance(l, jax.ShapeDtypeStruct) for l in jax.tree.leaves(ab))
+ts = [jnp.ones((3, 3)), jnp.zeros((5,))]
+back = unflatten(flatten(ts), ts)
+assert back[0].shape == (3, 3)
+see_memory_usage("verify-driver", force=True)
+print("utils ok")
+
+# 4. configure() global API drives a jitted grad
+from deepspeed_tpu import checkpointing
+
+checkpointing.reset()
+checkpointing.configure(checkpoint_in_cpu=True)
+w = jax.random.normal(jax.random.PRNGKey(0), (8, 8))
+g = jax.jit(jax.grad(lambda w: checkpointing.checkpoint(
+    lambda x, w: jax.nn.relu(x @ w), jnp.ones((2, 8)), w).sum()))(w)
+assert np.isfinite(np.asarray(g)).all()
+print("configure/checkpoint API ok")
+
+print("VERIFY PASS")

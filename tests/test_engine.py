@@ -176,3 +176,22 @@ def test_eval_batch():
     engine = _make_engine(zero_stage=1)
     loss = engine.eval_batch(random_tokens(16))
     assert np.isfinite(loss)
+
+
+def test_zero_opt_state_bias_leaves_sharded():
+    """Every leaf's optimizer state takes the ZeRO axis, including biases whose
+    logical axes carry no ZeRO rule (reference shards *all* flat-buffer slices
+    across DP ranks, stage_1_and_2.py:93 — round-2 weak #7)."""
+    engine = _make_engine(zero_stage=2)
+    for name in ("bq", "bk", "bv", "bi"):
+        m_spec = str(engine.state["opt"]["m"]["layers"][name].sharding.spec)
+        assert "fsdp" in m_spec or "data" in m_spec, f"{name} opt state replicated: {m_spec}"
+    # params themselves stay replicated at stage 2
+    p_spec = str(engine.state["params"]["layers"]["bq"].sharding.spec)
+    assert "fsdp" not in p_spec and "data" not in p_spec
+
+
+def test_zero3_bias_params_sharded():
+    engine = _make_engine(zero_stage=3)
+    spec = str(engine.state["params"]["layers"]["bq"].sharding.spec)
+    assert "fsdp" in spec or "data" in spec
