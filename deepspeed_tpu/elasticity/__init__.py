@@ -7,3 +7,4 @@ from .elasticity import (
     compute_elastic_config,
     get_valid_gpus,
 )
+from .elastic_agent import DSElasticAgent, WorkerSpec  # noqa: F401

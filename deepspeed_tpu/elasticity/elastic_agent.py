@@ -1,0 +1,167 @@
+"""Elastic agent — supervise training across membership changes.
+
+Reference: ``DSElasticAgent(LocalElasticAgent)`` (elasticity/elastic_agent.py:23)
+rides torch-elastic: rendezvous tracks membership, workers are restarted on
+join/leave, and DeepSpeed's contribution is recomputing the batch config for
+the new world size.
+
+TPU-native framing: a pod has no NCCL rendezvous to re-form — membership is
+the reservation (hostfile / node list), and ``jax.distributed`` re-initializes
+on relaunch. So the agent is a small supervisor:
+
+1. read membership (hostfile, reread every ``monitor_interval``),
+2. validate the world size against the elastic config
+   (``compute_elastic_config`` — the batch-size algebra both here and in the
+   reference), picking the micro-batch for that world,
+3. launch the worker command with the DSTPU_* env the launcher stack already
+   consumes (launcher/launch.py:child_env),
+4. on worker death or membership change: terminate the tree, recompute, and
+   relaunch (bounded by ``max_restarts``); training state carries across via
+   checkpoint-resume (engine.save/load_checkpoint), which is the recovery
+   story on re-schedulable TPU jobs.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from ..launcher.launch import terminate_process_tree
+from ..utils.logging import logger
+from .elasticity import ElasticityIncompatibleWorldSize, compute_elastic_config
+
+
+@dataclass
+class WorkerSpec:
+    """What to run for one elastic generation.
+
+    ``command`` is either a ready argv list or a callable
+    ``(world_size, micro_batch, final_batch) -> argv`` so the training script
+    can receive the recomputed batch settings."""
+
+    command: Sequence[str] | Callable[[int, int, int], Sequence[str]]
+    extra_env: dict = field(default_factory=dict)
+
+    def argv(self, world_size: int, micro_batch: int, final_batch: int) -> list[str]:
+        if callable(self.command):
+            return list(self.command(world_size, micro_batch, final_batch))
+        return list(self.command)
+
+
+class DSElasticAgent:
+    def __init__(
+        self,
+        ds_config: dict,
+        spec: WorkerSpec,
+        hostfile: Optional[str] = None,
+        static_world_size: Optional[int] = None,
+        monitor_interval: float = 1.0,
+        max_restarts: int = 3,
+    ):
+        if hostfile is None and static_world_size is None:
+            raise ValueError("need a hostfile to watch or a static_world_size")
+        self.ds_config = ds_config
+        self.spec = spec
+        self.hostfile = hostfile
+        self.static_world_size = static_world_size
+        self.monitor_interval = monitor_interval
+        self.max_restarts = max_restarts
+        self.restart_count = 0
+        self._proc: Optional[subprocess.Popen] = None
+
+    # -- membership ----------------------------------------------------
+    def current_world_size(self) -> int:
+        if self.hostfile is None:
+            return int(self.static_world_size)
+        from ..launcher.runner import fetch_hostfile
+
+        hosts = fetch_hostfile(self.hostfile)
+        return sum(hosts.values())
+
+    # -- one generation ------------------------------------------------
+    def _resolve(self, world_size: int) -> tuple[int, int]:
+        final_batch, _valid, micro = compute_elastic_config(
+            self.ds_config, world_size=world_size)
+        return final_batch, micro
+
+    def _launch(self, world_size: int) -> subprocess.Popen:
+        final_batch, micro = self._resolve(world_size)
+        argv = self.spec.argv(world_size, micro, final_batch)
+        env = dict(os.environ)
+        env.update(
+            DSTPU_ELASTIC_WORLD_SIZE=str(world_size),
+            DSTPU_ELASTIC_MICRO_BATCH=str(micro),
+            DSTPU_ELASTIC_BATCH=str(final_batch),
+            DSTPU_ELASTIC_GENERATION=str(self.restart_count),
+            **self.spec.extra_env,
+        )
+        logger.info(
+            "elastic agent: launching generation %d at world=%d "
+            "(batch=%d, micro=%d): %s",
+            self.restart_count, world_size, final_batch, micro, argv)
+        return subprocess.Popen(argv, env=env, start_new_session=True)
+
+    def _stop(self, sig=signal.SIGTERM):
+        if self._proc is not None and self._proc.poll() is None:
+            terminate_process_tree(self._proc.pid, sig)
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                terminate_process_tree(self._proc.pid, signal.SIGKILL)
+                self._proc.wait()
+
+    # -- supervision loop ----------------------------------------------
+    def run(self, max_generations: Optional[int] = None) -> int:
+        """Supervise until the worker exits cleanly (returns 0), restarts are
+        exhausted (returns the last rc), or the world becomes infeasible
+        (raises ElasticityIncompatibleWorldSize)."""
+        world = self.current_world_size()
+        self._proc = self._launch(world)
+        generations = 1
+        try:
+            while True:
+                rc = self._proc.poll()
+                if rc is not None:
+                    if rc == 0:
+                        logger.info("elastic agent: worker finished cleanly")
+                        return 0
+                    if self.restart_count >= self.max_restarts:
+                        logger.error(
+                            "elastic agent: worker failed (rc=%d), restarts "
+                            "exhausted (%d)", rc, self.max_restarts)
+                        return rc
+                    self.restart_count += 1
+                    logger.warning(
+                        "elastic agent: worker failed (rc=%d), restart %d/%d",
+                        rc, self.restart_count, self.max_restarts)
+                    world = self.current_world_size()
+                    self._proc = self._launch(world)
+                    generations += 1
+                else:
+                    new_world = self.current_world_size()
+                    if new_world != world:
+                        if self.restart_count >= self.max_restarts:
+                            logger.error(
+                                "elastic agent: membership %d -> %d but restarts "
+                                "exhausted (%d); stopping",
+                                world, new_world, self.max_restarts)
+                            self._stop()
+                            return 1
+                        logger.warning(
+                            "elastic agent: membership %d -> %d; restarting",
+                            world, new_world)
+                        self._stop()
+                        self.restart_count += 1
+                        world = new_world
+                        self._proc = self._launch(world)
+                        generations += 1
+                if max_generations is not None and generations >= max_generations:
+                    rc = self._proc.wait()
+                    return rc
+                time.sleep(self.monitor_interval)
+        finally:
+            self._stop()

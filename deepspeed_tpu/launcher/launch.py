@@ -24,13 +24,49 @@ from ..utils.logging import logger
 
 def parse_args(args=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--node_rank", type=int, required=True)
+    p.add_argument("--node_rank", type=str, required=True,
+                   help="int rank, 'mpi' (read the MPI launcher's rank env), "
+                        "or 'auto' (match hostname against world_info)")
     p.add_argument("--num_nodes", type=int, required=True)
     p.add_argument("--coordinator", type=str, required=True)
     p.add_argument("--world_info", type=str, default="")
     p.add_argument("user_script", type=str)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
-    return p.parse_args(args)
+    ns = p.parse_args(args)
+    ns.node_rank = resolve_node_rank(ns.node_rank, ns.world_info)
+    return ns
+
+
+def resolve_node_rank(spec: str, world_info: str = "") -> int:
+    """Node rank from an explicit int, the MPI launcher's env (OpenMPI /
+    MVAPICH / PMI — reference multinode_runner.py runners launch one process
+    per node through mpirun), or the hostname's position in world_info
+    (pdsh, which offers no rank variable)."""
+    if spec == "mpi":
+        for var in ("OMPI_COMM_WORLD_RANK", "MV2_COMM_WORLD_RANK", "PMI_RANK",
+                    "PMIX_RANK"):
+            if var in os.environ:
+                return int(os.environ[var])
+        raise RuntimeError("--node_rank=mpi but no MPI rank variable in env")
+    if spec == "auto":
+        import socket
+
+        from .runner import decode_world_info
+
+        hosts = list(decode_world_info(world_info))
+        name = socket.gethostname()
+        short = name.split(".")[0]
+        # exact match first — prefix matching alone mis-ranks host sets where
+        # one name prefixes another (node1 / node10)
+        for candidate in (name, short):
+            if candidate in hosts:
+                return hosts.index(candidate)
+        # then FQDN-vs-short equivalence, requiring a '.' boundary
+        for i, h in enumerate(hosts):
+            if name.startswith(h + ".") or h.startswith(name + ".") or h.split(".")[0] == short:
+                return i
+        raise RuntimeError(f"hostname {name} not found in world_info hosts {hosts}")
+    return int(spec)
 
 
 def terminate_process_tree(pid: int, sig=signal.SIGTERM) -> None:

@@ -43,7 +43,7 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=("ssh", "pdsh", "local"))
+                        choices=("ssh", "pdsh", "openmpi", "mvapich", "local"))
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("user_script", type=str)
@@ -140,15 +140,16 @@ def decode_world_info(encoded: str) -> dict:
 
 
 def build_node_command(
-    node_rank: int,
+    node_rank,
     num_nodes: int,
     coordinator: str,
     world_info: str,
     user_script: str,
     user_args: list[str],
 ) -> list[str]:
-    """The per-node command executed (via ssh/pdsh or locally): runs
-    launcher.launch with rendezvous env."""
+    """The per-node command executed (via ssh/pdsh/mpirun or locally): runs
+    launcher.launch with rendezvous env. ``node_rank`` may be an int or the
+    'mpi'/'auto' resolution specs (launch.resolve_node_rank)."""
     cmd = [
         sys.executable,
         "-m",
@@ -188,22 +189,22 @@ def main(args=None):
     master = args.master_addr or next(iter(active))
     coordinator = f"{master}:{args.master_port}"
     world_info = encode_world_info(active)
-    env = _exportable_env()
-    env_prefix = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+
+    from .multinode_runner import get_runner
+
+    runner = get_runner(args.launcher, args.launcher_args, _exportable_env())
+    if not runner.backend_exists():
+        logger.warning(f"launcher backend for {runner.name!r} not found on PATH")
+
+    def node_cmd_for(rank_spec):
+        return build_node_command(
+            rank_spec, len(active), coordinator, world_info,
+            args.user_script, args.user_args,
+        )
 
     procs = []
-    for rank, host in enumerate(active):
-        node_cmd = build_node_command(
-            rank, len(active), coordinator, world_info, args.user_script, args.user_args
-        )
-        remote = f"cd {shlex.quote(os.getcwd())} && {env_prefix} {shlex.join(node_cmd)}"
-        if args.launcher == "pdsh":
-            cmd = ["pdsh", "-w", host] + shlex.split(args.launcher_args) + [remote]
-        else:  # ssh
-            cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host] + shlex.split(
-                args.launcher_args
-            ) + [remote]
-        logger.info(f"node {rank} ({host}): {shlex.join(cmd)}")
+    for cmd in runner.get_cmd(active, node_cmd_for):
+        logger.info(f"[{runner.name}] {shlex.join(cmd)}")
         procs.append(subprocess.Popen(cmd))
 
     rc = 0

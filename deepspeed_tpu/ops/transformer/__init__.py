@@ -1,0 +1,7 @@
+from .transformer_layer import (  # noqa: F401
+    DeepSpeedInferenceConfig,
+    DeepSpeedStochasticTransformerLayer,
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerInference,
+    DeepSpeedTransformerLayer,
+)

@@ -1079,6 +1079,19 @@ class DeepSpeedEngine:
             async_save=self._ckpt_async,
             latest=(os.path.join(save_dir, "latest"), tag),
         )
+        if jax.process_index() == 0:
+            # drop the standalone recovery script next to the checkpoint
+            # (reference runtime/engine.py:3172 copies zero_to_fp32.py) so
+            # weights are extractable with numpy alone, no training stack.
+            import shutil
+
+            from ..checkpoint import zero_to_fp32
+
+            try:
+                shutil.copyfile(
+                    zero_to_fp32.__file__, os.path.join(save_dir, "zero_to_fp32.py"))
+            except OSError as e:
+                logger.warning(f"could not copy zero_to_fp32.py into {save_dir}: {e}")
         log_dist(
             f"saved checkpoint {save_dir}/{tag}" + (" (async)" if self._ckpt_async else ""),
             ranks=[0],

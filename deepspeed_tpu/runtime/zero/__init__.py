@@ -6,3 +6,4 @@ from .memory_estimators import (  # noqa: F401
     estimate_zero3_model_states_mem_needs,
     print_mem_estimates,
 )
+from .tiling import TiledLinear, TiledLinearConfig, split_tensor_along_dim  # noqa: F401

@@ -1,0 +1,124 @@
+"""Tiled linear layers — bounded-memory matmuls for very large projections.
+
+Reference: ``deepspeed.zero.TiledLinear`` (runtime/zero/tiling.py:1-296) splits
+one huge ``nn.Linear`` into ``in_splits × out_splits`` sub-linears so that,
+under ZeRO-3, only one tile's weights are gathered (and only one partial
+product is live) at a time — the memory high-water mark scales with the tile,
+not the full layer.
+
+TPU-native form: the tiles are a leading axis of one weight array and the
+contraction is a ``lax.scan`` over input tiles with ``jax.checkpoint`` on the
+body. Under ZeRO-3 sharding rules the tile axis keeps its own dimension, so
+XLA's SPMD partitioner all-gathers one tile per scan step (the reference's
+fetch/release coordinator, expressed as program structure), and remat frees
+each tile's partial products immediately. Out-tiling exists for API parity and
+for splitting the *output* dimension of e.g. vocab projections, where the
+live logits slab is the concern.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class TiledLinearConfig:
+    in_features: int
+    out_features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+
+    def __post_init__(self):
+        assert self.in_splits >= 1 and self.out_splits >= 1
+        assert self.in_features % self.in_splits == 0, (
+            f"in_splits {self.in_splits} must divide in_features {self.in_features}")
+        assert self.out_features % self.out_splits == 0, (
+            f"out_splits {self.out_splits} must divide out_features {self.out_features}")
+
+
+class TiledLinear:
+    """Functional tiled linear: ``init(rng) -> params``, ``apply(params, x)``.
+
+    Weight layout: ``w[in_splits, in_tile, out_features]`` — the scan gathers
+    and contracts one ``[in_tile, out_features]`` slab per step. ``out_splits``
+    further chunks the output dimension inside each step.
+    """
+
+    def __init__(self, in_features: int, out_features: int, in_splits: int = 1,
+                 out_splits: int = 1, use_bias: bool = True):
+        self.config = TiledLinearConfig(in_features, out_features, in_splits,
+                                        out_splits, use_bias)
+
+    # -- parameters ----------------------------------------------------
+    def init(self, rng, scale: Optional[float] = None) -> dict:
+        c = self.config
+        scale = scale if scale is not None else (1.0 / jnp.sqrt(c.in_features))
+        w = jax.random.normal(
+            rng, (c.in_splits, c.in_features // c.in_splits, c.out_features)
+        ) * scale
+        params = {"w": w}
+        if c.use_bias:
+            params["b"] = jnp.zeros((c.out_features,))
+        return params
+
+    def logical_axes(self) -> dict:
+        # tile axis unsharded (it is the scan axis); embed/mlp take TP/ZeRO
+        # rules from parallel/sharding.DEFAULT_TP_RULES.
+        axes = {"w": ("layers", "embed", "mlp")}
+        if self.config.use_bias:
+            axes["b"] = ("mlp",)
+        return axes
+
+    # -- conversion (reference TiledLinear.copy_params_from) -----------
+    def from_dense(self, w_dense: jax.Array, b: Optional[jax.Array] = None) -> dict:
+        c = self.config
+        assert w_dense.shape == (c.in_features, c.out_features)
+        params = {"w": w_dense.reshape(c.in_splits, c.in_features // c.in_splits,
+                                       c.out_features)}
+        if c.use_bias:
+            params["b"] = b if b is not None else jnp.zeros((c.out_features,))
+        return params
+
+    def to_dense(self, params: dict) -> tuple[jax.Array, Optional[jax.Array]]:
+        c = self.config
+        return params["w"].reshape(c.in_features, c.out_features), params.get("b")
+
+    # -- forward -------------------------------------------------------
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        c = self.config
+        lead = x.shape[:-1]
+        x2 = x.reshape((-1, c.in_features))
+        xt = x2.reshape((x2.shape[0], c.in_splits, c.in_features // c.in_splits))
+        xt = jnp.moveaxis(xt, 1, 0)  # [in_splits, N, in_tile]
+
+        def tile_step(acc, xw):
+            x_i, w_i = xw  # [N, in_tile], [in_tile, out]
+            if c.out_splits > 1:
+                # chunk the output dim so only one [N, out_tile] slab is live
+                w_cols = w_i.reshape(w_i.shape[0], c.out_splits, -1)
+                parts = [x_i @ w_cols[:, j] for j in range(c.out_splits)]
+                y = jnp.concatenate(parts, axis=-1)
+            else:
+                y = x_i @ w_i
+            return acc + y, None
+
+        body = jax.checkpoint(tile_step, prevent_cse=False)
+        acc0 = jnp.zeros((x2.shape[0], c.out_features), x.dtype)
+        y, _ = lax.scan(body, acc0, (xt.astype(x.dtype), params["w"].astype(x.dtype)))
+        if c.use_bias and "b" in params:
+            y = y + params["b"].astype(y.dtype)
+        return y.reshape(lead + (c.out_features,))
+
+    __call__ = apply
+
+
+def split_tensor_along_dim(t: jax.Array, splits: int, dim: int) -> list[jax.Array]:
+    """Reference tiling helper (partition a tensor for manual tile handling)."""
+    assert t.shape[dim] % splits == 0
+    return list(jnp.split(t, splits, axis=dim))

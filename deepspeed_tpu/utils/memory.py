@@ -37,11 +37,13 @@ def see_memory_usage(message: str, force: bool = False) -> dict:
     """Log current + peak device memory and host RSS; returns the numbers.
 
     Mirrors the reference's call sites: drop a one-liner at a phase boundary.
-    ``force=False`` matches the reference's gating flag (callers thread a
-    config bit through it).
+    As in the reference, nothing is logged (or measured) unless ``force`` —
+    callers thread a config bit through it.
     """
     import jax
 
+    if not force:
+        return {}
     stats = device_memory_stats()
     used = stats.get("bytes_in_use", 0) / 1024**3
     peak = stats.get("peak_bytes_in_use", 0) / 1024**3

@@ -1,0 +1,244 @@
+"""Elastic agent + multinode runner tests (reference:
+tests/unit/test_elastic.py + launcher command-construction behavior)."""
+
+import json
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    DSElasticAgent,
+    ElasticityIncompatibleWorldSize,
+    WorkerSpec,
+    compute_elastic_config,
+)
+from deepspeed_tpu.launcher.launch import resolve_node_rank
+from deepspeed_tpu.launcher.multinode_runner import (
+    MVAPICHRunner,
+    OpenMPIRunner,
+    PDSHRunner,
+    SSHRunner,
+    get_runner,
+)
+from deepspeed_tpu.launcher.runner import build_node_command, encode_world_info
+
+ELASTIC_CFG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 64,
+        "micro_batch_sizes": [1, 2, 4],
+        "min_gpus": 1,
+        "max_gpus": 16,
+        "min_time": 0,
+        "version": 0.1,
+    }
+}
+
+
+# ---------------------------------------------------------------- agent
+def test_agent_clean_exit(tmp_path):
+    agent = DSElasticAgent(
+        ELASTIC_CFG,
+        WorkerSpec(command=[sys.executable, "-c", "print('ok')"]),
+        static_world_size=4,
+        monitor_interval=0.05,
+    )
+    assert agent.run() == 0
+    assert agent.restart_count == 0
+
+
+def test_agent_restarts_failed_worker(tmp_path):
+    marker = tmp_path / "attempts"
+
+    # fail twice, then succeed
+    script = (
+        "import pathlib, sys\n"
+        f"p = pathlib.Path({str(marker)!r})\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "sys.exit(0 if n >= 2 else 1)\n"
+    )
+    agent = DSElasticAgent(
+        ELASTIC_CFG,
+        WorkerSpec(command=[sys.executable, "-c", script]),
+        static_world_size=4,
+        monitor_interval=0.05,
+        max_restarts=5,
+    )
+    assert agent.run() == 0
+    assert agent.restart_count == 2
+    assert marker.read_text() == "3"
+
+
+def test_agent_exhausts_restarts():
+    agent = DSElasticAgent(
+        ELASTIC_CFG,
+        WorkerSpec(command=[sys.executable, "-c", "import sys; sys.exit(3)"]),
+        static_world_size=4,
+        monitor_interval=0.05,
+        max_restarts=1,
+    )
+    assert agent.run() == 3
+    assert agent.restart_count == 1
+
+
+def test_agent_restarts_on_membership_change(tmp_path):
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("node-0 slots=4\n")
+    out = tmp_path / "worlds"
+
+    script = (
+        "import os, pathlib, time\n"
+        f"p = pathlib.Path({str(out)!r})\n"
+        "with p.open('a') as f: f.write(os.environ['DSTPU_ELASTIC_WORLD_SIZE'] + '\\n')\n"
+        "time.sleep(30)\n"
+    )
+    agent = DSElasticAgent(
+        ELASTIC_CFG,
+        WorkerSpec(command=[sys.executable, "-c", script]),
+        hostfile=str(hostfile),
+        monitor_interval=0.1,
+        max_restarts=3,
+    )
+    import threading
+
+    def shrink_then_kill():
+        # interpreter startup is seconds here (site hooks); give each
+        # generation time to write its world size before moving on
+        time.sleep(5.0)
+        hostfile.write_text("node-0 slots=4\nnode-1 slots=8\n")
+        time.sleep(8.0)
+        agent._stop(signal.SIGKILL)
+
+    t = threading.Thread(target=shrink_then_kill)
+    t.start()
+    rc = agent.run(max_generations=2)
+    t.join()
+    worlds = out.read_text().split()
+    assert worlds[0] == "4"
+    assert "12" in worlds  # relaunched at the grown world size
+    assert agent.restart_count >= 1
+    assert rc != 0  # we killed it
+
+
+def test_agent_passes_batch_env():
+    final, valid, micro = compute_elastic_config(ELASTIC_CFG, world_size=12)
+    code = (
+        "import os, sys\n"
+        f"ok = (os.environ['DSTPU_ELASTIC_BATCH'] == '{final}' and "
+        f"os.environ['DSTPU_ELASTIC_MICRO_BATCH'] == '{micro}')\n"
+        "sys.exit(0 if ok else 9)\n"
+    )
+    agent = DSElasticAgent(
+        ELASTIC_CFG,
+        WorkerSpec(command=[sys.executable, "-c", code]),
+        static_world_size=12,
+        monitor_interval=0.05,
+    )
+    assert agent.run() == 0
+
+
+def test_agent_rejects_incompatible_world():
+    cfg = json.loads(json.dumps(ELASTIC_CFG))
+    cfg["elasticity"]["micro_batch_sizes"] = [64]
+    cfg["elasticity"]["max_train_batch_size"] = 64
+    agent = DSElasticAgent(
+        cfg,
+        WorkerSpec(command=[sys.executable, "-c", "pass"]),
+        static_world_size=3,
+        monitor_interval=0.05,
+    )
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        agent.run()
+
+
+# ----------------------------------------------------- multinode runners
+def _active():
+    from collections import OrderedDict
+
+    return OrderedDict([("node-0", [0]), ("node-1", [0])])
+
+
+def _node_cmd_for(rank_spec):
+    return build_node_command(rank_spec, 2, "node-0:29500",
+                              encode_world_info(_active()), "train.py", ["--x"])
+
+
+def test_ssh_runner_one_cmd_per_node_with_ranks():
+    cmds = SSHRunner().get_cmd(_active(), _node_cmd_for)
+    assert len(cmds) == 2
+    assert cmds[0][0] == "ssh" and "node-0" in cmds[0]
+    assert "--node_rank=0" in cmds[0][-1] and "--node_rank=1" in cmds[1][-1]
+
+
+def test_pdsh_runner_single_fanout_auto_rank():
+    cmds = PDSHRunner().get_cmd(_active(), _node_cmd_for)
+    assert len(cmds) == 1
+    assert cmds[0][0] == "pdsh" and "node-0,node-1" in cmds[0]
+    assert "--node_rank=auto" in cmds[0][-1]
+
+
+def test_openmpi_runner_mpirun_shape():
+    cmds = OpenMPIRunner(env={"FOO": "1"}).get_cmd(_active(), _node_cmd_for)
+    assert len(cmds) == 1
+    cmd = cmds[0]
+    assert cmd[0] == "mpirun"
+    assert cmd[cmd.index("-n") + 1] == "2"
+    assert "node-0:1,node-1:1" in cmd
+    assert "FOO=1" in cmd  # -x exported
+    assert "--node_rank=mpi" in cmd
+
+
+def test_mvapich_runner_writes_hostfile(tmp_path):
+    hf = str(tmp_path / "mv2_hosts")
+    cmds = MVAPICHRunner(hostfile_path=hf).get_cmd(_active(), _node_cmd_for)
+    assert cmds[0][0] == "mpirun_rsh"
+    assert open(hf).read().split() == ["node-0", "node-1"]
+    assert "--node_rank=mpi" in cmds[0]
+
+
+def test_get_runner_rejects_unknown():
+    with pytest.raises(ValueError):
+        get_runner("slurm")
+
+
+# ------------------------------------------------------ rank resolution
+def test_resolve_node_rank_int_and_mpi(monkeypatch):
+    assert resolve_node_rank("3") == 3
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "2")
+    assert resolve_node_rank("mpi") == 2
+
+
+def test_resolve_node_rank_auto(monkeypatch):
+    import socket
+
+    info = encode_world_info(_active())
+    monkeypatch.setattr(socket, "gethostname", lambda: "node-1")
+    assert resolve_node_rank("auto", info) == 1
+    monkeypatch.setattr(socket, "gethostname", lambda: "node-9")
+    with pytest.raises(RuntimeError):
+        resolve_node_rank("auto", info)
+
+
+def test_resolve_node_rank_auto_prefix_collision(monkeypatch):
+    """node10 must not match node1 (exact match precedes prefix matching)."""
+    import socket
+    from collections import OrderedDict
+
+    info = encode_world_info(OrderedDict([("node1", [0]), ("node10", [0])]))
+    monkeypatch.setattr(socket, "gethostname", lambda: "node10")
+    assert resolve_node_rank("auto", info) == 1
+    monkeypatch.setattr(socket, "gethostname", lambda: "node1.cluster.local")
+    assert resolve_node_rank("auto", info) == 0
+
+
+def test_local_runner_registered():
+    from deepspeed_tpu.launcher.multinode_runner import LocalRunner
+
+    r = get_runner("local")
+    assert isinstance(r, LocalRunner)
+    cmds = r.get_cmd(_active(), _node_cmd_for)
+    assert len(cmds) == 2 and "--node_rank=0" in " ".join(cmds[0])
