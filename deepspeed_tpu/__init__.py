@@ -16,8 +16,14 @@ __git_branch__ = "main"
 from .runtime.config import DeepSpeedConfig
 from .runtime.engine import DeepSpeedEngine
 from .runtime import activation_checkpointing as checkpointing  # noqa: F401
+from .runtime import zero  # noqa: F401
 from .utils.logging import log_dist, logger
 from . import comm
+
+import sys as _sys
+
+# reference spelling: ``import deepspeed.zero`` / ``from deepspeed.zero import Init``
+_sys.modules[__name__ + ".zero"] = zero
 
 
 def initialize(

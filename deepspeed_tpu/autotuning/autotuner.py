@@ -135,16 +135,74 @@ class Autotuner:
 
     # -- cost model (reference: model-based tuner; here the flops profiler
     # estimate ranks candidates before any compilation) ---------------------
+    def _model_config_for(self, overrides: dict):
+        """Model config for a candidate, cached — ranking should not build a
+        throwaway model per candidate per sort key."""
+        key = tuple(sorted((k, str(v)) for k, v in overrides.items()))
+        if not hasattr(self, "_mc_cache"):
+            self._mc_cache = {}
+        if key not in self._mc_cache:
+            self._mc_cache[key] = getattr(self.model_factory(overrides), "config", None)
+        return self._mc_cache[key]
+
+    def _device_mem_gb(self) -> float:
+        stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)() or {}
+        limit = stats.get("bytes_limit", 0)
+        return limit / 1e9 if limit else 16.0  # v5e-class default
+
+    def _estimate_mem_gb(self, overrides: dict) -> Optional[float]:
+        """Rough HBM high-water estimate (activations + model/opt states) so
+        the ranking never spends its trial budget compiling candidates that
+        cannot fit — the first real sweep burned every trial on remat=none at
+        full micro-batch (compile-time OOM through the tunnel)."""
+        mc = self._model_config_for(overrides)
+        if mc is None or not hasattr(mc, "num_layers"):
+            return None
+        cfg = self._apply_overrides(overrides)
+        dp = self._dp_size(cfg)
+        micro = cfg.get("train_micro_batch_size_per_gpu",
+                        cfg["train_batch_size"] // dp)
+        L, S, D = mc.num_layers, mc.max_seq_len, mc.hidden_size
+        F = getattr(mc, "intermediate_size", None) or 4 * D
+        policy = overrides.get("remat_policy",
+                               mc.remat_policy if getattr(mc, "remat", False) else "none")
+        # live activation tensors per layer, in units of the bf16 residual
+        # stream [B, S, D]: none keeps every matmul output AND their incoming
+        # gradients at the backward peak (hence the 2x — the chip sweep showed
+        # remat=none OOMs exactly where the un-doubled estimate said it fit);
+        # dots keeps matmul outs but recomputes elementwise; save_flash keeps
+        # only the boundary + flash out/lse
+        k = {"none": 2 * (10 + 2 * F / D), "dots_and_flash": 5 + 2 * F / D,
+             "save_flash": 3.0}.get(policy, 3.0)
+        act_gb = L * micro * S * D * 2 * k / 1e9
+        n_params = L * (4 * D * D + 2 * D * F) + getattr(mc, "vocab_size", 0) * D
+        stage = overrides.get("zero_stage", 1)
+        opt_shard = max(1, dp) if stage >= 1 else 1
+        par_shard = max(1, dp) if stage >= 3 else 1
+        states_gb = n_params * (2 / par_shard + 16 / opt_shard) / 1e9
+        return act_gb + states_gb
+
     def _cost_rank(self, overrides: dict) -> float:
         """Lower = more promising. Heuristics: less remat recompute and
         bigger micro-batches are faster; higher zero stages add collectives
-        on multi-device meshes (free on one chip)."""
+        on multi-device meshes (free on one chip). Candidates whose memory
+        estimate exceeds HBM sink to the back of the ranking."""
         rank = 0.0
         policy = overrides.get("remat_policy", "save_flash")
         rank += {"none": 0.0, "dots_and_flash": 0.5, "save_flash": 1.0}.get(policy, 1.5)
         rank += overrides.get("micro_batch_divisor", 1) * 0.25
         if len(jax.devices()) > 1:
             rank += {1: 0.0, 2: 0.1, 3: 0.3, 0: 0.0}.get(overrides.get("zero_stage", 1), 0)
+        try:
+            est = self._estimate_mem_gb(overrides)
+            hbm = self._device_mem_gb()
+        except Exception:  # noqa: BLE001 — estimation must never kill tuning
+            est = hbm = None
+        if est is not None and est > hbm:
+            logger.info(
+                f"autotune: {overrides} estimated {est:.1f} GB > HBM "
+                f"{hbm:.1f} GB; deprioritized")
+            rank += 100.0 + est
         return rank
 
     # -- measurement --------------------------------------------------------

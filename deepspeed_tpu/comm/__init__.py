@@ -24,6 +24,11 @@ from .collectives import (
     reduce_scatter,
     ring_shift,
 )
+from .compressed import (
+    CompressedBackend,
+    compressed_allreduce,
+    compressed_allreduce_p,
+)
 from .logger import CommsLogger, comms_logger, get_bw
 from .mesh import (
     AXIS_ORDER,

@@ -54,6 +54,13 @@ def init_distributed(
         num_processes = int(os.environ["DSTPU_NUM_PROCESSES"])
     if process_id is None and os.environ.get("DSTPU_PROCESS_ID"):
         process_id = int(os.environ["DSTPU_PROCESS_ID"])
+    if coordinator_address is None and auto_mpi_discovery:
+        disc = mpi_discovery()
+        if disc is not None:
+            coordinator_address = disc["coordinator"]
+            num_processes = num_processes or disc["world_size"]
+            process_id = process_id if process_id is not None else disc["rank"]
+            logger.info(f"rendezvous discovered from MPI/scheduler env: {disc}")
     # num_processes=None lets jax.distributed auto-detect (TPU pod metadata);
     # only an explicit single-process launch skips rendezvous.
     if coordinator_address is not None and num_processes != 1:
@@ -66,6 +73,41 @@ def init_distributed(
             f"jax.distributed initialized: process {jax.process_index()}/{jax.process_count()}"
         )
     _INITIALIZED = True
+
+
+def mpi_discovery(port: int = 29500) -> Optional[dict]:
+    """Derive (rank, world_size, coordinator) from a launcher's environment —
+    the reference's ``mpi_discovery`` + AML/SageMaker paths (comm/comm.py:640-
+    750), minus any actual MPI import: the variables the launchers export are
+    enough, and the transport is jax.distributed either way.
+
+    Recognized: OpenMPI (OMPI_*), MVAPICH/PMI (MV2_*/PMI_*), torchrun-style
+    (RANK/WORLD_SIZE + MASTER_ADDR), Azure-ML (AZ_BATCH_MASTER_NODE).
+    Returns None when nothing is set."""
+    env = os.environ
+    rank = size = None
+    for rk, sk in (("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+                   ("MV2_COMM_WORLD_RANK", "MV2_COMM_WORLD_SIZE"),
+                   ("PMI_RANK", "PMI_SIZE"),
+                   ("RANK", "WORLD_SIZE")):
+        if rk in env and sk in env:
+            rank, size = int(env[rk]), int(env[sk])
+            break
+    if rank is None:
+        return None
+    if "AZ_BATCH_MASTER_NODE" in env:  # AML: "<ip>:<port>"
+        coordinator = env["AZ_BATCH_MASTER_NODE"]
+    elif "MASTER_ADDR" in env:
+        coordinator = f"{env['MASTER_ADDR']}:{env.get('MASTER_PORT', port)}"
+    else:
+        import socket
+
+        coordinator = f"{socket.gethostname()}:{port}"
+        if size > 1 and rank == 0:
+            logger.warning(
+                "mpi_discovery: no MASTER_ADDR; using this host as coordinator "
+                "— set MASTER_ADDR for multi-node runs")
+    return {"rank": rank, "world_size": size, "coordinator": coordinator}
 
 
 def is_initialized() -> bool:

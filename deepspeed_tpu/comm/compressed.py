@@ -1,0 +1,80 @@
+"""Compressed collective backend — standalone 1-bit error-feedback allreduce.
+
+Reference: ``runtime/comm/nccl.py:51`` ``NcclBackend.compressed_allreduce``
+(and ``mpi.py:170``): sign-compress a worker's tensor with an error-feedback
+residual, allreduce the 1-bit payload + per-tensor scale, return the dense
+average — the comm kernel under the 1-bit optimizers, also usable directly.
+
+TPU-native: the compression is elementwise math and the "1-bit transport" is
+a bf16 sign tensor reduced with ``lax.pmean`` over the mesh axis — XLA lowers
+the narrow-dtype all-reduce over ICI/DCN, which is where the bandwidth win
+lives. The function is written for use INSIDE ``shard_map`` (per-device view,
+like the reference's per-rank code); ``compressed_allreduce`` is the
+convenience wrapper that builds the shard_map for host-level callers.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Axes = Union[str, Sequence[str]]
+
+
+def compressed_allreduce_p(tensor: jax.Array, error: jax.Array, axes: Axes):
+    """Per-device (inside shard_map): returns (averaged_tensor, new_error).
+
+    ``tensor`` is this rank's local dense value; ``error`` its accumulated
+    compression residual (same shape). The 1-bit payload is sign(tensor +
+    error) with one L1 scale per tensor (reference nccl.py:51 layout)."""
+    comp = tensor + error
+    scale = jnp.sum(jnp.abs(comp)) / comp.size
+    sign = jnp.sign(comp).astype(jnp.bfloat16)  # the 1-bit wire format
+    avg = lax.pmean(scale * sign.astype(jnp.float32), axes)
+    new_error = comp - scale * jnp.sign(comp)
+    return avg, new_error
+
+
+def compressed_allreduce(tensor: jax.Array, error: jax.Array, axis: str = "data",
+                         mesh=None):
+    """Host-level convenience: shard_map ``compressed_allreduce_p`` over
+    ``axis``. ``tensor``/``error`` carry a leading [world] axis holding each
+    rank's local value (the per-rank layout the reference sees naturally as
+    separate processes)."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from .mesh import current_mesh
+
+    mesh = mesh if mesh is not None else current_mesh()
+    assert mesh is not None, "compressed_allreduce needs a mesh"
+    world = mesh.shape[axis]
+    if tensor.shape[0] != world:
+        raise ValueError(
+            f"leading world axis {tensor.shape[0]} != mesh axis {axis!r} size "
+            f"{world} — each rank's local value must occupy exactly one row")
+
+    def per_device(t, e):
+        avg, e_new = compressed_allreduce_p(t[0], e[0], axis)
+        return avg[None], e_new[None]
+
+    spec = P(axis)
+    fn = shard_map(per_device, mesh=mesh, in_specs=(spec, spec),
+                   out_specs=(P(axis), spec))
+    avg_stack, new_error = fn(tensor, error)
+    # every rank computed the same average; return one copy + per-rank errors
+    return avg_stack[0], new_error
+
+
+class CompressedBackend:
+    """Name-compatible object API (reference NcclBackend/MpiBackend)."""
+
+    def __init__(self, axis: str = "data", mesh=None):
+        self.axis = axis
+        self.mesh = mesh
+
+    def compressed_allreduce(self, tensor, error, rank=None, world_size=None):
+        return compressed_allreduce(tensor, error, axis=self.axis, mesh=self.mesh)

@@ -99,13 +99,12 @@ def momentum_sync(g_local, m, v, error_local, step, cfg: OneBitAdamConfig, dp_ax
         g_local, m, v, error_local = operands
 
         def leaf(g, m, v, err):
-            e = err[0]  # local slice [1, ...] -> [...]
+            from ..comm.compressed import compressed_allreduce_p
+
             m_loc = b1 * m + (1.0 - b1) * g
-            comp = m_loc + e
-            scale = jnp.sum(jnp.abs(comp)) / comp.size  # one scale per tensor
-            sgn = jnp.sign(comp).astype(jnp.bfloat16)  # the 1-bit payload
-            m_new = lax.pmean(scale * sgn.astype(jnp.float32), dp_axes)
-            err_new = comp - scale * jnp.sign(comp)
+            # shared 1-bit kernel (comm/compressed.py — the reference's
+            # NcclBackend.compressed_allreduce); err[0] = this rank's slice
+            m_new, err_new = compressed_allreduce_p(m_loc, err[0], dp_axes)
             return m_new, v, err_new[None]
 
         return _tree_leaf3(leaf, g_local, m, v, error_local)

@@ -8,3 +8,8 @@ from .sparsity_config import (  # noqa: F401
     SparsityConfig,
     VariableSparsityConfig,
 )
+from .sparse_self_attention import (  # noqa: F401
+    BertSparseSelfAttention,
+    SparseAttentionUtils,
+    SparseSelfAttention,
+)

@@ -1,0 +1,158 @@
+"""Sparse self-attention module API + padding utilities.
+
+Reference: ``ops/sparse_attention/sparse_self_attention.py`` —
+``SparseSelfAttention`` (the nn.Module over the blocksparse matmul/softmax
+Triton kernels), ``bert_sparse_self_attention.py`` (drop-in BERT attention),
+and ``sparse_attention_utils.py`` ``SparseAttentionUtils`` (pad inputs to the
+block size, extend position embeddings for longer sequences).
+
+TPU-native: the compute goes through the Pallas block-sparse flash kernel
+(kernels.sparse_flash_attention), the layout comes from the same
+SparsityConfig family, and masked paths fall back to dense XLA attention with
+the block layout materialized as an additive mask — masks make the access
+pattern data-dependent, which is exactly what the static block lists cannot
+express (the reference pays a dense softmax for the masked rows too, via its
+RPE/key-padding handling).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kernels import sparse_flash_attention
+from .sparsity_config import FixedSparsityConfig, SparsityConfig
+
+
+class SparseSelfAttention:
+    """Attention with a block-sparse pattern.
+
+    ``apply(q, k, v, key_padding_mask=None, attn_mask=None)`` with q/k/v
+    [B, S, H, D] (the model family's layout). Without masks the Pallas kernel
+    runs (only active blocks cost anything); with masks the layout is applied
+    as an additive bias on the dense XLA path."""
+
+    def __init__(self, sparsity_config: Optional[SparsityConfig] = None,
+                 causal: bool = True, softmax_scale: Optional[float] = None,
+                 max_seq_length: int = 2048):
+        self.config = sparsity_config or FixedSparsityConfig(num_heads=1, block=64)
+        self.causal = causal
+        self.softmax_scale = softmax_scale
+        self._layout_cache: dict[int, np.ndarray] = {}
+
+    def layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layout_cache:
+            self._layout_cache[seq_len] = np.asarray(self.config.make_layout(seq_len))
+        return self._layout_cache[seq_len]
+
+    def _dense_mask(self, seq_len: int) -> np.ndarray:
+        """[H or 1, S, S] additive mask materialized from the block layout
+        (per-head layouts keep their per-head patterns)."""
+        layout = self.layout(seq_len)
+        if layout.ndim == 2:
+            layout = layout[None]
+        if (layout == layout[0]).all():
+            layout = layout[:1]
+        blk = seq_len // layout.shape[1]
+        full = np.stack([np.kron(l, np.ones((blk, blk), np.float32)) for l in layout])
+        return np.where(full > 0, 0.0, -1e9).astype(np.float32)
+
+    def apply(self, q, k, v, key_padding_mask=None, attn_mask=None):
+        B, S, H, D = q.shape
+        if key_padding_mask is None and attn_mask is None:
+            return sparse_flash_attention(
+                q, k, v, self.layout(S), causal=self.causal,
+                sm_scale=self.softmax_scale)
+        bias = jnp.asarray(self._dense_mask(S))[None]  # [1, H|1, S, S]
+        if attn_mask is not None:
+            am = jnp.asarray(attn_mask, jnp.float32)
+            if am.ndim == 2:  # [B, S] key mask (BERT spelling)
+                am = am[:, None, None, :]
+            elif am.ndim == 3:  # [B, S, S]
+                am = am[:, None]
+            bias = bias + am
+        if key_padding_mask is not None:
+            kp = jnp.asarray(key_padding_mask, jnp.float32)  # [B, S]; 1 = keep
+            bias = bias + jnp.where(kp > 0, 0.0, -1e9)[:, None, None, :]
+        from ...models.transformer import xla_attention
+
+        return xla_attention(q, k, v, bias=bias, causal=self.causal)
+
+    __call__ = apply
+
+
+class BertSparseSelfAttention:
+    """BERT-shaped attention block with sparse attention inside (reference
+    bert_sparse_self_attention.py): owns q/k/v projections, consumes the
+    [B, S, hidden] stream and the standard BERT additive attention mask."""
+
+    def __init__(self, hidden_size: int, num_heads: int,
+                 sparsity_config: Optional[SparsityConfig] = None):
+        assert hidden_size % num_heads == 0
+        self.hidden_size = hidden_size
+        self.num_heads = num_heads
+        self.head_dim = hidden_size // num_heads
+        self.attn = SparseSelfAttention(
+            sparsity_config or FixedSparsityConfig(num_heads=num_heads, block=64),
+            causal=False)
+
+    def init(self, rng) -> dict:
+        ks = jax.random.split(rng, 3)
+        scale = 1.0 / np.sqrt(self.hidden_size)
+        shp = (self.hidden_size, self.num_heads, self.head_dim)
+        return {
+            "wq": jax.random.normal(ks[0], shp) * scale,
+            "wk": jax.random.normal(ks[1], shp) * scale,
+            "wv": jax.random.normal(ks[2], shp) * scale,
+        }
+
+    def apply(self, params: dict, hidden_states, attention_mask=None):
+        q = jnp.einsum("bsd,dhk->bshk", hidden_states, params["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", hidden_states, params["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", hidden_states, params["wv"])
+        ctx = self.attn.apply(q, k, v, attn_mask=attention_mask)
+        B, S = ctx.shape[:2]
+        return ctx.reshape(B, S, self.hidden_size)
+
+    __call__ = apply
+
+
+class SparseAttentionUtils:
+    """Reference sparse_attention_utils.py — sequence-length plumbing."""
+
+    @staticmethod
+    def pad_to_block_size(block: int, tokens=None, embeddings=None,
+                          attention_mask=None, pad_token_id: int = 0):
+        """Right-pad [B, S, ...] inputs so S is block-divisible; returns
+        (pad_len, tokens, embeddings, attention_mask)."""
+        ref = tokens if tokens is not None else embeddings
+        assert ref is not None
+        S = ref.shape[1]
+        pad = (-S) % block
+        if pad == 0:
+            return 0, tokens, embeddings, attention_mask
+
+        def padded(x, value):
+            if x is None:
+                return None
+            widths = [(0, 0)] * x.ndim
+            widths[1] = (0, pad)
+            return jnp.pad(x, widths, constant_values=value)
+
+        return (pad, padded(tokens, pad_token_id), padded(embeddings, 0),
+                padded(attention_mask, 0))
+
+    @staticmethod
+    def unpad_sequence_output(pad_len: int, sequence_output):
+        return sequence_output if pad_len == 0 else sequence_output[:, :-pad_len]
+
+    @staticmethod
+    def extend_position_embedding(pos_emb, max_position: int):
+        """Tile a [S, D] learned position table to ``max_position`` rows —
+        the reference's recipe for running BERT beyond its trained length."""
+        S, D = pos_emb.shape
+        reps = -(-max_position // S)
+        return jnp.concatenate([pos_emb] * reps, axis=0)[:max_position]

@@ -158,6 +158,32 @@ class DeepSpeedEngine:
                 model.config = model.config.replace(**overrides)
                 logger.info("activation_checkpointing: %s", overrides)
 
+        # ---- config blocks that translate into model-config fields ---------
+        # (reference wires these through engine construction too: PLD at
+        # engine.py progressive_layer_drop, sparse attention at config.py:283)
+        if hasattr(model, "config") and hasattr(model.config, "replace"):
+            mc_over = {}
+            pld = self.config.progressive_layer_drop
+            if pld.enabled and not getattr(model.config, "pld_enabled", False):
+                mc_over.update(pld_enabled=True, pld_theta=pld.theta, pld_gamma=pld.gamma)
+            sa = self.config.sparse_attention
+            if sa is not None and getattr(model.config, "attn_impl", "") != "sparse":
+                import dataclasses
+                import inspect
+
+                from ..ops.sparse_attention import SPARSITY_CONFIGS
+
+                accepted = set(inspect.signature(
+                    SPARSITY_CONFIGS[sa.mode].__init__).parameters)
+                fields = dataclasses.asdict(sa)
+                mc_over.update(attn_impl="sparse", sparsity={
+                    "mode": sa.mode,
+                    **{k: v for k, v in fields.items() if k in accepted},
+                })
+            if mc_over:
+                model.config = model.config.replace(**mc_over)
+                logger.info("model config from DS config blocks: %s", mc_over)
+
         # ---- sharding rules --------------------------------------------------
         zstage = self.config.zero_optimization.stage
         self.zero_stage = zstage
@@ -808,10 +834,25 @@ class DeepSpeedEngine:
             self._train_step = self._build_train_step()
         if self.curriculum_scheduler is not None:
             batch = self._apply_curriculum(batch)
+        wcb = self.config.wall_clock_breakdown
         self.tput_timer.start()
+        if wcb:
+            # profiling mode (reference EngineTimers, engine.py:139-177): a
+            # per-step sync is the point here — async chaining is the fast path
+            self.timers("train_batch").start()
+            self.timers("step_dispatch").start()
         self.state, metrics = self._train_step(self.state, batch)
+        if wcb:
+            self.timers("step_dispatch").stop()
+            # scalar fetch, not block_until_ready: the latter returns early on
+            # the tunneled TPU backend (see bench.py sync + docs/PERF.md)
+            np.asarray(jax.device_get(metrics["loss"]))
+            self.timers("train_batch").stop()
         self.tput_timer.stop()
         self.global_steps += 1
+        fp = self.config.flops_profiler
+        if fp.enabled and self.global_steps == fp.profile_step:
+            self._run_flops_profiler(batch)
         if self.quant_scheduler is not None:
             self._maybe_quantize_weights()
         self.global_samples += self.train_batch_size
@@ -822,6 +863,10 @@ class DeepSpeedEngine:
             metrics = jax.device_get(metrics)
             if self.global_steps % self.config.steps_per_print == 0:
                 self._report_progress(metrics)
+                if wcb:
+                    self.timers.log(["train_batch", "step_dispatch"],
+                                    normalizer=self.config.steps_per_print,
+                                    memory_breakdown=True)
             self.monitor.write_events(
                 [
                     ("Train/Samples/train_loss", float(metrics["loss"]), self.global_samples),
@@ -829,6 +874,27 @@ class DeepSpeedEngine:
                 ]
             )
         return metrics
+
+    def _run_flops_profiler(self, batch):
+        """flops_profiler config block (reference engine.py:1608-1627: print
+        the profile at ``profile_step``). Profiles the model's loss over one
+        micro-batch shape with the jaxpr walker + XLA cost analysis."""
+        from ..profiling.flops_profiler.profiler import FlopsProfiler
+
+        try:
+            micro = jax.tree.map(
+                lambda x: x[: max(1, x.shape[0] // self.gradient_accumulation_steps)],
+                batch)
+            prof = FlopsProfiler(self.config.flops_profiler)
+            res = prof.profile(
+                lambda p, b: self.model.loss(p, b),
+                self.state.get("master", self.state["params"]), micro,
+                params=self.state["params"])
+            if jax.process_index() == 0:
+                prof.print_model_profile(
+                    res, detailed=self.config.flops_profiler.detailed)
+        except Exception as e:  # noqa: BLE001 — profiling must not kill training
+            logger.warning(f"flops profiler failed: {e}")
 
     def _maybe_quantize_weights(self):
         """MoQ: fake-quantize the weight matrices at the scheduled bit-width

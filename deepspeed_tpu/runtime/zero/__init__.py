@@ -7,3 +7,4 @@ from .memory_estimators import (  # noqa: F401
     print_mem_estimates,
 )
 from .tiling import TiledLinear, TiledLinearConfig, split_tensor_along_dim  # noqa: F401
+from .partition_parameters import GatheredParameters, Init  # noqa: F401

@@ -1,0 +1,136 @@
+"""zero.Init / GatheredParameters — ZeRO-3 construction-time sharding API.
+
+Reference: ``runtime/zero/partition_parameters.py`` — ``Init`` (:537) monkey-
+patches every ``nn.Module.__init__`` so parameters shard across DP ranks the
+moment they are constructed (a 100B model never materializes replicated), and
+``GatheredParameters`` (:1512) temporarily all-gathers a partitioned param for
+host-side surgery.
+
+TPU-native: construction-time sharding is one jit — ``jax.jit(init_fn,
+out_shardings=stage3_shardings)`` materializes every leaf directly into its
+shard (the engine's zero.Init analogue, runtime/engine.py); no interception
+machinery exists because params are pytree values, not module attributes.
+This module packages that idiom behind the reference's API names for porting
+users, plus the gather context:
+
+    with zero.Init(mesh=mesh) as zinit:
+        params = zinit.materialize(model.init, rng, model.logical_axes())
+
+    with zero.GatheredParameters(params) as full:
+        inspect(full)            # fully-replicated copies, freed on exit
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from ...parallel import sharding as shd
+
+PyTree = Any
+
+
+class Init:
+    """Materialize parameters directly into their ZeRO-3 shards."""
+
+    def __init__(self, mesh=None, config_dict_or_path=None, dtype=None,
+                 enabled: bool = True, **_compat):
+        from ...comm.mesh import current_mesh
+
+        self.mesh = mesh if mesh is not None else current_mesh()
+        self.dtype = dtype
+        self.enabled = enabled
+        stage = 3
+        if isinstance(config_dict_or_path, dict):
+            stage = (config_dict_or_path.get("zero_optimization", {}) or {}).get("stage", 3)
+        self.param_rules, _ = shd.zero_stage_rules(stage)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def materialize(self, init_fn: Callable, rng, logical_axes: Optional[PyTree] = None):
+        """Run ``init_fn(rng)`` with every leaf born sharded (never replicated
+        — the reference's whole point at :537)."""
+        if not self.enabled or self.mesh is None:
+            out = init_fn(rng)
+            return jax.tree.map(self._cast, out) if self.dtype else out
+        shapes = jax.eval_shape(init_fn, rng)
+        if logical_axes is None:
+            specs = jax.tree.map(lambda s: shd.PartitionSpec(), shapes)
+        else:
+            specs = jax.tree.map(
+                lambda ax, s: shd.spec_from_logical(
+                    ax, tuple(s.shape), self.param_rules, self.mesh,
+                    zero_fallback=("fsdp", "data")),
+                logical_axes,
+                shapes,
+                is_leaf=lambda x: x is None or (
+                    isinstance(x, tuple) and all(isinstance(e, (str, type(None))) for e in x)),
+            )
+        shardings = shd.tree_shardings(self.mesh, specs)
+        fn = init_fn if self.dtype is None else (
+            lambda r: jax.tree.map(self._cast, init_fn(r)))
+        return jax.jit(fn, out_shardings=shardings)(rng)
+
+    def _cast(self, x):
+        import jax.numpy as jnp
+
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(self.dtype)
+        return x
+
+
+class GatheredParameters:
+    """Temporarily fully-replicated copies of sharded params (reference
+    :1512). ``modifier_rank`` is accepted for signature parity; writes made
+    to the gathered copies are pushed back (resharded) on exit when
+    ``modifier_rank`` is not None, matching the reference's update semantics."""
+
+    def __init__(self, params: PyTree, modifier_rank: Optional[int] = None,
+                 enabled: bool = True, **_compat):
+        self.params = params
+        self.modifier_rank = modifier_rank
+        self.enabled = enabled
+        self.gathered: Optional[PyTree] = None
+
+    def __enter__(self):
+        if not self.enabled:
+            self.gathered = self.params
+            return self.gathered
+
+        def gather(x):
+            if not hasattr(x, "sharding"):
+                return x
+            mesh = getattr(x.sharding, "mesh", None)
+            if mesh is None:
+                return x
+            return jax.device_put(
+                x, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+
+        self.gathered = jax.tree.map(gather, self.params)
+        return self.gathered
+
+    def __exit__(self, *exc):
+        if self.enabled and self.modifier_rank is not None and self.gathered is not None:
+            # push edits back into the sharded layout
+            def scatter(orig, new):
+                if hasattr(orig, "sharding") and hasattr(new, "shape"):
+                    return jax.device_put(new, orig.sharding)
+                return new
+
+            updated = jax.tree.map(scatter, self.params, self.gathered)
+            # in-place update only possible for mutable containers
+            if isinstance(self.params, dict):
+                flat_new = jax.tree_util.tree_flatten_with_path(updated)[0]
+                for path, leaf in flat_new:
+                    node = self.params
+                    for p in path[:-1]:
+                        node = node[getattr(p, "key", getattr(p, "idx", None))]
+                    last = path[-1]
+                    node[getattr(last, "key", getattr(last, "idx", None))] = leaf
+        self.gathered = None
+        return False

@@ -195,3 +195,39 @@ def test_zero3_bias_params_sharded():
     engine = _make_engine(zero_stage=3)
     spec = str(engine.state["params"]["layers"]["bq"].sharding.spec)
     assert "fsdp" in spec or "data" in spec
+
+
+def test_wall_clock_breakdown_times_steps():
+    """wall_clock_breakdown=True activates the per-step synced timers
+    (reference EngineTimers, engine.py:139-177) instead of being parsed and
+    dropped."""
+    engine = _make_engine(zero_stage=0, wall_clock_breakdown=True)
+    batch = random_tokens(16)
+    engine.train_batch(batch)
+    engine.train_batch(batch)
+    assert engine.timers("train_batch").count == 2
+    assert engine.timers("train_batch").elapsed(reset=False) > 0
+    assert engine.timers("step_dispatch").count == 2
+    # off by default: no timers populated
+    engine2 = _make_engine(zero_stage=0)
+    engine2.train_batch(batch)
+    assert "train_batch" not in engine2.timers.timers
+
+
+def test_pld_and_sparse_attention_config_blocks_reach_model():
+    """progressive_layer_drop / sparse_attention DS-config blocks translate
+    into model-config fields instead of being parsed and dropped."""
+    model = tiny_transformer(max_seq_len=64)
+    cfg = base_config()
+    cfg["mesh"] = {"data": -1}
+    cfg["progressive_layer_drop"] = {"enabled": True, "theta": 0.6, "gamma": 0.002}
+    cfg["sparse_attention"] = {"mode": "fixed", "block": 16, "num_local_blocks": 2,
+                               "num_global_blocks": 1}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    mc = engine.model.config
+    assert mc.pld_enabled and mc.pld_theta == 0.6 and mc.pld_gamma == 0.002
+    assert mc.attn_impl == "sparse" and mc.sparsity["mode"] == "fixed"
+    # and the resulting engine still trains (sparse kernel path, 64-seq)
+    batch = {"tokens": np.random.default_rng(0).integers(0, 128, (16, 65)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(3)]
+    assert np.isfinite(losses).all()

@@ -108,3 +108,22 @@ def test_env_report_runs():
     info = collect()
     assert info["jax"]
     assert "native_aio" in info
+
+
+def test_flops_profiler_config_block_runs_at_profile_step(capsys):
+    """flops_profiler DS-config block triggers the profile print at
+    profile_step (reference engine.py:1608-1627) instead of being ignored."""
+    import deepspeed_tpu
+    from simple_model import base_config, random_tokens, tiny_transformer
+
+    model = tiny_transformer()
+    cfg = base_config()
+    cfg["mesh"] = {"data": -1}
+    cfg["flops_profiler"] = {"enabled": True, "profile_step": 2, "detailed": False}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    batch = random_tokens(16)
+    engine.train_batch(batch)
+    capsys.readouterr()
+    engine.train_batch(batch)  # step 2: profile printed
+    out = capsys.readouterr().out
+    assert "flops profiler" in out and "params:" in out

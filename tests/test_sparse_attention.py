@@ -107,3 +107,63 @@ def test_empty_row_rejected():
     layout[0, 0] = 1  # row 1 empty after tril
     with pytest.raises(ValueError, match="no keys"):
         layout_to_lists(layout, causal=True)
+
+
+def test_sparse_self_attention_module_matches_kernel():
+    """SparseSelfAttention module == direct kernel call; with a key-padding
+    mask it equals dense attention under layout+padding bias."""
+    from deepspeed_tpu.ops.sparse_attention import (
+        FixedSparsityConfig,
+        SparseSelfAttention,
+    )
+
+    B, S, H, D = 2, 128, 2, 16
+    cfg = FixedSparsityConfig(num_heads=H, block=32, num_local_blocks=2,
+                              num_global_blocks=1)
+    attn = SparseSelfAttention(cfg, causal=True)
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (B, S, H, D)) for i in range(3))
+    out = attn.apply(q, k, v)
+    from deepspeed_tpu.ops.sparse_attention import sparse_flash_attention
+
+    ref = sparse_flash_attention(q, k, v, attn.layout(S), causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+    # masked path: padded keys cannot contribute
+    kp = np.ones((B, S), np.float32)
+    kp[:, S // 2:] = 0
+    out_masked = attn.apply(q, k, v, key_padding_mask=kp)
+    assert not np.allclose(np.asarray(out), np.asarray(out_masked))
+
+
+def test_bert_sparse_self_attention_shapes():
+    from deepspeed_tpu.ops.sparse_attention import (
+        BertSparseSelfAttention,
+        FixedSparsityConfig,
+    )
+
+    mod = BertSparseSelfAttention(
+        hidden_size=32, num_heads=2,
+        sparsity_config=FixedSparsityConfig(num_heads=2, block=32, num_local_blocks=2))
+    params = mod.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 32))
+    y = mod.apply(params, x)
+    assert y.shape == (2, 64, 32)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_sparse_attention_utils_pad_unpad():
+    from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+
+    toks = jnp.ones((2, 50), jnp.int32)
+    mask = jnp.ones((2, 50), jnp.int32)
+    pad, toks2, _, mask2 = SparseAttentionUtils.pad_to_block_size(
+        block=32, tokens=toks, attention_mask=mask, pad_token_id=7)
+    assert pad == 14 and toks2.shape == (2, 64)
+    assert int(toks2[0, -1]) == 7 and int(mask2[0, -1]) == 0
+    seq_out = jnp.ones((2, 64, 8))
+    assert SparseAttentionUtils.unpad_sequence_output(pad, seq_out).shape == (2, 50, 8)
+
+    pos = jnp.arange(512 * 4, dtype=jnp.float32).reshape(512, 4)
+    ext = SparseAttentionUtils.extend_position_embedding(pos, 1024)
+    assert ext.shape == (1024, 4)
+    np.testing.assert_allclose(np.asarray(ext[512:]), np.asarray(pos))
