@@ -100,13 +100,16 @@ def mpi_discovery(port: int = 29500) -> Optional[dict]:
     elif "MASTER_ADDR" in env:
         coordinator = f"{env['MASTER_ADDR']}:{env.get('MASTER_PORT', port)}"
     else:
+        if size > 1:
+            # guessing each rank's own hostname would point every node's
+            # rendezvous at itself and hang jax.distributed.initialize
+            raise RuntimeError(
+                "mpi_discovery: MPI rank env found but no MASTER_ADDR / "
+                "AZ_BATCH_MASTER_NODE — export MASTER_ADDR=<rank-0 host> "
+                "(mpirun -x MASTER_ADDR=...) for multi-node runs")
         import socket
 
         coordinator = f"{socket.gethostname()}:{port}"
-        if size > 1 and rank == 0:
-            logger.warning(
-                "mpi_discovery: no MASTER_ADDR; using this host as coordinator "
-                "— set MASTER_ADDR for multi-node runs")
     return {"rank": rank, "world_size": size, "coordinator": coordinator}
 
 

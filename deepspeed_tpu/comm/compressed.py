@@ -45,7 +45,7 @@ def compressed_allreduce(tensor: jax.Array, error: jax.Array, axis: str = "data"
     rank's local value (the per-rank layout the reference sees naturally as
     separate processes)."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     from .mesh import current_mesh
 

@@ -22,3 +22,8 @@ from .replace_policy import (
     policy_for,
     replace_module,
 )
+from .layers import (  # noqa: F401
+    LinearAllreduce,
+    LinearLayer,
+    ReplaceWithTensorSlicing,
+)

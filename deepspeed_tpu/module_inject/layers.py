@@ -1,0 +1,109 @@
+"""Tensor-parallel linear layers + weight-slicing helper.
+
+Reference: ``module_inject/layers.py:9-59`` — ``LinearAllreduce`` (row-parallel
+linear: each rank holds an input-dim slice, local matmul, all-reduce the
+partial outputs) and ``LinearLayer`` (column-parallel: output-dim slice, no
+comm) — the building blocks injection slices HF models into; and
+``ReplaceWithTensorSlicing`` (module_inject/replace_module.py:18), the
+qkv-aware weight slicer.
+
+TPU-native: the *placement* is a sharding on the weight and the collective is
+derived by XLA — ``apply`` just annotates; there is no hand-written psum on
+the happy path. The classes exist so porting users find the same names and so
+the sliced layout can be constructed/verified explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..checkpoint.state_dict_factory import merge_query_key_value, split_query_key_value
+
+
+class LinearLayer:
+    """Column-parallel linear: weight [in, out] sharded on OUT over the
+    ``model`` axis; output stays sharded (the paired LinearAllreduce brings
+    it back). Reference layers.py:44 LinearLayer."""
+
+    def __init__(self, mesh=None, axis: str = "model"):
+        self.mesh = mesh
+        self.axis = axis
+
+    def shard(self, w: jax.Array, b: Optional[jax.Array] = None) -> dict:
+        params = {"w": w, "b": b} if b is not None else {"w": w}
+        if self.mesh is not None and self.mesh.shape.get(self.axis, 1) > 1:
+            params["w"] = jax.device_put(w, NamedSharding(self.mesh, P(None, self.axis)))
+            if b is not None:
+                params["b"] = jax.device_put(b, NamedSharding(self.mesh, P(self.axis)))
+        return params
+
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        y = x @ params["w"]
+        if "b" in params and params["b"] is not None:
+            y = y + params["b"]
+        return y
+
+    __call__ = apply
+
+
+class LinearAllreduce:
+    """Row-parallel linear: weight [in, out] sharded on IN; XLA derives the
+    all-reduce of the partial products when the input arrives sharded on its
+    contraction dim (the hand-written ``dist.all_reduce`` at reference
+    layers.py:9-20). Output constrained replicated over ``model``."""
+
+    def __init__(self, mesh=None, axis: str = "model"):
+        self.mesh = mesh
+        self.axis = axis
+
+    def shard(self, w: jax.Array, b: Optional[jax.Array] = None) -> dict:
+        params = {"w": w, "b": b} if b is not None else {"w": w}
+        if self.mesh is not None and self.mesh.shape.get(self.axis, 1) > 1:
+            params["w"] = jax.device_put(w, NamedSharding(self.mesh, P(self.axis, None)))
+        return params
+
+    def apply(self, params: dict, x: jax.Array) -> jax.Array:
+        y = x @ params["w"]
+        if self.mesh is not None and self.mesh.shape.get(self.axis, 1) > 1:
+            U = P.UNCONSTRAINED
+            spec = P(*([U] * (y.ndim - 1) + [None]))
+            y = jax.lax.with_sharding_constraint(y, NamedSharding(self.mesh, spec))
+        if "b" in params and params["b"] is not None:
+            y = y + params["b"]  # bias AFTER the reduce (reference :17)
+        return y
+
+    __call__ = apply
+
+
+class ReplaceWithTensorSlicing:
+    """Host-side weight slicer (reference replace_module.py:18): cut a full
+    weight into this rank's TP slice, with fused-qkv awareness."""
+
+    def __init__(self, mp_size: int = 1, mp_rank: int = 0, num_heads: int = 0,
+                 version: float = 2.0):
+        self.mp_size = mp_size
+        self.mp_rank = mp_rank
+        self.num_heads = num_heads
+        self.version = version
+
+    def copy(self, full: np.ndarray, dim: int = -1, is_qkv: bool = False) -> np.ndarray:
+        if self.mp_size == 1:
+            return np.asarray(full)
+        full = np.asarray(full)
+        if is_qkv:
+            return np.asarray(split_query_key_value(
+                full, self.mp_size, self.mp_rank, num_heads=self.num_heads,
+                version=self.version))
+        assert full.shape[dim] % self.mp_size == 0, (full.shape, dim, self.mp_size)
+        return np.split(full, self.mp_size, axis=dim)[self.mp_rank]
+
+    def merge(self, shards, is_qkv: bool = False, dim: int = -1) -> np.ndarray:
+        if is_qkv:
+            return np.asarray(merge_query_key_value(
+                shards, num_heads=self.num_heads, version=self.version))
+        return np.concatenate([np.asarray(s) for s in shards], axis=dim)

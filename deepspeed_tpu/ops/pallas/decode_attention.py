@@ -46,6 +46,12 @@ def _interpret_default() -> bool:
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
                    *, sm_scale, block_k, num_kb):
+    # All-elementwise formulation: decode attention at T=1 is a matvec per
+    # head — pure HBM streaming, so the MXU buys nothing and the VPU does the
+    # whole block in consistent (kk, H, D)-shaped broadcasts/reductions.
+    # (A head-batched dot_general fails Mosaic's attr parser on hardware, and
+    # per-head 2D-dot blocks violate the (sublane, lane) tiling rules for the
+    # [B, S, H, D] cache layout — this shape avoids dots entirely.)
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -60,33 +66,28 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(j <= jmax)
     def _compute():
-        q = q_ref[0]        # [H, D]
-        k = k_ref[0]        # [Bk, H, D]
-        v = v_ref[0]
-        # s[h, kk] = sum_d q[h, d] * k[kk, h, d]
-        s = sm_scale * jax.lax.dot_general(
-            q, k, (((1,), (2,)), ((0,), (1,))), preferred_element_type=jnp.float32
-        )  # [H, Bk]
-        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(k_pos <= pos, s, NEG_INF)
-        m_prev = m_scr[...]                       # [H, Bk] lane-broadcast tile
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev[:, 0:1] - m_new[:, 0:1])  # [H, 1]
-        m_scr[...] = jnp.broadcast_to(m_new[:, 0:1], m_scr.shape)
-        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        # acc[h, d] += sum_kk p[h, kk] * v[kk, h, d]
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32,
-        )  # [H, D]
+        q3 = q_ref[...].astype(jnp.float32)       # [1, H, D]
+        k3 = k_ref[0].astype(jnp.float32)         # [Bk, H, D]
+        v3 = v_ref[0].astype(jnp.float32)
+        # s[kk, h] = sum_d q[h, d] * k[kk, h, d], kept as [Bk, H, 1]
+        s3 = sm_scale * jnp.sum(k3 * q3, axis=2, keepdims=True)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s3.shape, 0)
+        s3 = jnp.where(k_pos <= pos, s3, NEG_INF)
+        m_prev = m_scr[:, :, 0:1]                 # [1, H, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s3, axis=0, keepdims=True))
+        p3 = jnp.exp(s3 - m_new)                  # [Bk, H, 1]
+        alpha = jnp.exp(m_prev - m_new)           # [1, H, 1]
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = l_scr[...] * alpha + jnp.broadcast_to(
+            jnp.sum(p3, axis=0, keepdims=True), l_scr.shape)
+        pv = jnp.sum(p3 * v3, axis=0, keepdims=True)  # [1, H, D]
         acc_scr[...] = acc_scr[...] * alpha + pv
 
     @pl.when(j == num_kb - 1)
     def _finalize():
-        l = l_scr[:, 0:1]
+        l = l_scr[:, :, 0:1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
 
 
 def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None, block_k: int = 512,
@@ -131,9 +132,9 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None, block_k: int = 
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda b, j, p: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((H, block_k), jnp.float32),
-            pltpu.VMEM((H, block_k), jnp.float32),
-            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((1, H, 1), jnp.float32),
+            pltpu.VMEM((1, H, 1), jnp.float32),
+            pltpu.VMEM((1, H, D), jnp.float32),
         ],
     )
     kernel = functools.partial(

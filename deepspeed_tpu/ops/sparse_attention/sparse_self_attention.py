@@ -69,9 +69,9 @@ class SparseSelfAttention:
         bias = jnp.asarray(self._dense_mask(S))[None]  # [1, H|1, S, S]
         if attn_mask is not None:
             am = jnp.asarray(attn_mask, jnp.float32)
-            if am.ndim == 2:  # [B, S] key mask (BERT spelling)
-                am = am[:, None, None, :]
-            elif am.ndim == 3:  # [B, S, S]
+            if am.ndim == 2:  # [B, S] 0/1 key mask (BERT spelling) -> additive
+                am = jnp.where(am > 0, 0.0, -1e9)[:, None, None, :]
+            elif am.ndim == 3:  # [B, S, S] additive
                 am = am[:, None]
             bias = bias + am
         if key_padding_mask is not None:

@@ -524,11 +524,30 @@ class DeepSpeedEngine:
             return new_master, new_opt, p16
 
         host_update = compute_on("device_host")(jax.jit(host_update))
+        hkind = self._host_memory_kind
+        master_shardings = self._to_host_shardings(
+            shd.tree_shardings(mesh, self.opt_specs_for_params))
+        param_shardings = shd.tree_shardings(mesh, param_specs)
 
         def apply_update(state, grads, finite, step1, lr):
+            if hkind:
+                # the host region's operands must ALL be in host memory space
+                # (the TPU runtime rejects mixed-space elementwise ops; the CPU
+                # test backend is lax about it) — stage the d2h copies
+                # explicitly so XLA schedules them as the reference schedules
+                # its grad-copy stream (cpu_adam.cpp + custom_cuda_kernel.cu)
+                grads = jax.tree.map(jax.device_put, grads, master_shardings)
+                host_scalar = NamedSharding(mesh, PartitionSpec(), memory_kind=hkind)
+                finite_h, step1_h, lr_h = (
+                    jax.device_put(x, host_scalar) for x in (finite, step1, lr))
+            else:
+                finite_h, step1_h, lr_h = finite, step1, lr
             new_master, new_opt, p16 = host_update(
-                grads, state["opt"], state["master"], finite, step1, lr
+                grads, state["opt"], state["master"], finite_h, step1_h, lr_h
             )
+            if hkind:
+                # h2d copy-back of the bf16 working weights
+                p16 = jax.tree.map(jax.device_put, p16, param_shardings)
             p16 = shd.constrain(p16, mesh, param_specs)
             return p16, new_opt, {"master": new_master}
 
@@ -1162,6 +1181,63 @@ class DeepSpeedEngine:
             f"saved checkpoint {save_dir}/{tag}" + (" (async)" if self._ckpt_async else ""),
             ranks=[0],
         )
+        return True
+
+    def load_universal_checkpoint(self, load_dir: str, tag: Optional[str] = None):
+        """Load a checkpoint saved under ANY topology (reference
+        engine.py:732 load_universal_checkpoint + checkpoint/universal_*).
+        Here every checkpoint is universal — the manifest stores global
+        shapes and load resharding targets the live mesh — so this is
+        load_checkpoint by another name, kept for API parity."""
+        return self.load_checkpoint(load_dir, tag=tag)
+
+    def _zero3_consolidated_16bit_state_dict(self) -> dict:
+        """Full (unsharded) compute-dtype weights as a flat path->array dict
+        (reference runtime/engine.py:3194): every ZeRO-3 shard gathered to
+        host, cast to the training compute dtype."""
+        cdt = self.config.compute_dtype
+        out = {}
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        for path, leaf in jax.tree_util.tree_flatten_with_path(self.state["params"])[0]:
+            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            if hasattr(leaf, "sharding") and not leaf.sharding.is_fully_replicated:
+                # collective gather: a ZeRO-3 shard spanning other hosts is
+                # not addressable for device_get; replicating first is a
+                # resharding EVERY process participates in (which is why the
+                # caller must not gate this method on process_index)
+                leaf = jax.device_put(leaf, replicated)
+            arr = np.asarray(jax.device_get(leaf))
+            if np.issubdtype(arr.dtype, np.floating) or arr.dtype.name == "bfloat16":
+                arr = arr.astype(cdt)
+            out[key] = arr
+        return out
+
+    def save_16bit_model(self, save_dir: str, save_filename: str = "model_weights.pt") -> bool:
+        """Write the consolidated compute-dtype weights for deployment
+        (reference engine.py:3264 save_16bit_model). Saved as a torch state
+        dict when torch is importable (ecosystem interchange), else .npz.
+
+        EVERY process must call this (the consolidation gathers shards
+        collectively); only process 0 writes the file."""
+        sd = self._zero3_consolidated_16bit_state_dict()
+        if jax.process_index() != 0:
+            return True
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, save_filename)
+        try:
+            import torch
+
+            def to_torch(v):
+                if v.dtype.name == "bfloat16":  # ml_dtypes bf16 -> torch bf16
+                    return torch.from_numpy(
+                        np.ascontiguousarray(v).view(np.uint16)).view(torch.bfloat16)
+                return torch.from_numpy(np.ascontiguousarray(v))
+
+            torch.save({k: to_torch(v) for k, v in sd.items()}, path)
+        except ImportError:
+            path = path.rsplit(".", 1)[0] + ".npz"
+            np.savez(path, **{k: v.astype(np.float32) for k, v in sd.items()})
+        log_dist(f"saved 16bit model weights to {path}", ranks=[0])
         return True
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):

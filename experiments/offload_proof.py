@@ -1,0 +1,86 @@
+"""ZeRO-Offload proof on real hardware: train a model whose fp32 master
+weights + Adam moments cannot fit in HBM.
+
+Config: GPT 1.4B-class (24L x 2048h x 16H, vocab 50304, seq 1024, micro 4).
+On-device states without offload: 2.8 GB bf16 params + 2.8 GB grads +
+16.8 GB fp32 master+moments = 22+ GB > 16 GB HBM -> must OOM.
+With offload_optimizer {device: cpu}: master+moments live in pinned host
+memory (132 GB here), device keeps bf16 params + grads + remat'd
+activations -> trains.
+
+Reference claim being matched: ZeRO-Offload trains 13B on one 32GB V100
+(10x the dense limit); same ratio argument on a 16 GB v5e.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+from deepspeed_tpu.runtime.zero import estimate_zero1_model_states_mem_needs
+
+L, H, D, V, S, B = 24, 16, 2048, 50304, 1024, 4
+
+cfg = TransformerConfig(
+    vocab_size=V, max_seq_len=S, num_layers=L, num_heads=H, hidden_size=D,
+    pos_emb="learned", dtype=jnp.bfloat16, remat=True, remat_policy="save_flash",
+    attn_impl="flash",
+)
+model = Model(cfg)
+n_params = L * (12 * D * D) + V * D
+print(f"model: {n_params/1e9:.2f}B params; fp32 master+moments = "
+      f"{n_params*12/1e9:.1f} GB; bf16 params = {n_params*2/1e9:.1f} GB")
+
+def run(offload: bool):
+    ds = {
+        "train_batch_size": B,
+        "train_micro_batch_size_per_gpu": B,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "steps_per_print": 10**9,
+        "mesh": {"data": -1},
+    }
+    if offload:
+        ds["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds)
+    toks = np.random.default_rng(0).integers(0, V, size=(B, S + 1)).astype(np.int32)
+    batch = {"tokens": toks}
+    m = engine.train_batch(batch)
+    l0 = float(np.asarray(jax.device_get(m["loss"])))
+    t0 = time.perf_counter()
+    steps = 3
+    for _ in range(steps):
+        m = engine.train_batch(batch)
+    l1 = float(np.asarray(jax.device_get(m["loss"])))
+    dt = (time.perf_counter() - t0) / steps
+    return l0, l1, dt
+
+
+mode = sys.argv[1] if len(sys.argv) > 1 else "offload"
+if mode == "dense":
+    # expected to OOM — run separately so the failure is isolated
+    try:
+        l0, l1, dt = run(offload=False)
+        print(json.dumps({"mode": "dense", "result": "ran", "loss0": l0}))
+    except Exception as e:
+        print(json.dumps({"mode": "dense", "result": "OOM/failed",
+                          "error": f"{type(e).__name__}: {str(e)[:200]}"}))
+else:
+    l0, l1, dt = run(offload=True)
+    tok_s = B * S / dt
+    print(json.dumps({
+        "mode": "offload", "result": "trained",
+        "params_B": round(n_params / 1e9, 2),
+        "loss_first": round(l0, 3), "loss_last": round(l1, 3),
+        "step_s": round(dt, 2), "tokens_per_sec": round(tok_s, 1),
+    }))

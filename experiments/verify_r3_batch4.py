@@ -1,0 +1,95 @@
+"""Verify driver: batch-4 surfaces (16bit export, module_inject TP layers,
+offload memory-space staging, decode kernel rewrite, universal-checkpoint
+alias) through the public API on the 8-device CPU mesh.
+
+Real-hardware flows already driven on the chip this batch (results in
+docs/PERF.md): offload_proof.py (1.31B trains with host-tier optimizer; dense
+control OOMs), decode kernel numerics vs XLA (3.6e-7), inference_latency.py
+(p50 68 ms dispatch-bound / 3.98 ms chained)."""
+
+import os
+import sys
+import tempfile
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+model = Model(TransformerConfig(vocab_size=128, max_seq_len=32, num_layers=2,
+                                num_heads=4, hidden_size=64, dtype=jnp.float32))
+engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+    "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+    "zero_optimization": {"stage": 3}, "bf16": {"enabled": True},
+    "mesh": {"data": 2, "fsdp": 4}})
+batch = {"tokens": np.random.default_rng(0).integers(0, 128, (8, 17)).astype(np.int32)}
+engine.train_batch(batch)
+
+# 1. 16bit export + universal-checkpoint alias round trip
+with tempfile.TemporaryDirectory() as d:
+    assert engine.save_16bit_model(d)
+    import torch
+
+    sd = torch.load(os.path.join(d, "model_weights.pt"), weights_only=True)
+    assert any(k.endswith("layers/wq") for k in sd)
+    engine.save_checkpoint(d, tag="u0")
+    tag, _ = engine.load_universal_checkpoint(d)
+    assert tag == "u0"
+print("16bit export + universal load ok")
+
+# 2. offload path (CPU backend exercises the staging code with memory kinds
+# inactive; the memory-space fix itself was validated on the real chip)
+model2 = Model(TransformerConfig(vocab_size=128, max_seq_len=32, num_layers=2,
+                                 num_heads=4, hidden_size=64, dtype=jnp.float32))
+eng2, _, _, _ = deepspeed_tpu.initialize(model=model2, config={
+    "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+    "zero_optimization": {"stage": 1, "offload_optimizer": {"device": "cpu"}},
+    "mesh": {"data": -1}})
+l0 = float(eng2.train_batch(batch)["loss"])
+l2 = None
+for _ in range(3):
+    l2 = float(eng2.train_batch(batch)["loss"])
+assert l2 < l0
+print("offload update path ok")
+
+# 3. module_inject TP layers end-to-end
+from collections import OrderedDict
+
+from jax.sharding import Mesh
+
+from deepspeed_tpu.module_inject import LinearAllreduce, LinearLayer
+
+mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "model"))
+col, row = LinearLayer(mesh=mesh), LinearAllreduce(mesh=mesh)
+w1 = jnp.ones((8, 16)) * 0.1
+w2 = jnp.ones((16, 8)) * 0.1
+y = jax.jit(lambda p1, p2, x: row.apply(p2, col.apply(p1, x)))(
+    col.shard(w1), row.shard(w2), jnp.ones((2, 8)))
+np.testing.assert_allclose(np.asarray(y), np.asarray((jnp.ones((2, 8)) @ w1) @ w2),
+                           rtol=1e-5)
+print("module_inject layers ok")
+
+# 4. decode kernel (interpret mode) matches dense cached attention
+from deepspeed_tpu.models.transformer import xla_attention
+from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+
+rng = np.random.default_rng(0)
+q = jnp.asarray(rng.standard_normal((2, 4, 64)), jnp.float32)
+k = jnp.asarray(rng.standard_normal((2, 256, 4, 64)), jnp.float32)
+v = jnp.asarray(rng.standard_normal((2, 256, 4, 64)), jnp.float32)
+out = decode_attention(q, k, v, 17)
+ref = xla_attention(jnp.expand_dims(q, 1), k, v, causal_offset=17)[:, 0]
+np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+print("decode kernel ok")
+
+print("VERIFY PASS")

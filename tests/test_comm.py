@@ -144,3 +144,16 @@ def test_compressed_backend_object_api(mesh8):
     be = CompressedBackend(axis="data", mesh=mesh8)
     avg, err2 = be.compressed_allreduce(t, err)
     np.testing.assert_allclose(np.asarray(avg), np.ones((4,)), rtol=1e-5)
+
+
+def test_mpi_discovery_multinode_requires_master_addr(monkeypatch):
+    from deepspeed_tpu.comm.collectives import mpi_discovery
+
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "2")
+    monkeypatch.delenv("MASTER_ADDR", raising=False)
+    with pytest.raises(RuntimeError):
+        mpi_discovery()
+    monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+    assert mpi_discovery() == {"rank": 1, "world_size": 2,
+                               "coordinator": "10.0.0.1:29500"}

@@ -231,3 +231,41 @@ def test_pld_and_sparse_attention_config_blocks_reach_model():
     batch = {"tokens": np.random.default_rng(0).integers(0, 128, (16, 65)).astype(np.int32)}
     losses = [float(engine.train_batch(batch)["loss"]) for _ in range(3)]
     assert np.isfinite(losses).all()
+
+
+def test_save_16bit_model_and_consolidated_state_dict(tmp_path):
+    """save_16bit_model / _zero3_consolidated_16bit_state_dict (reference
+    engine.py:3264/:3194): full unsharded compute-dtype weights from a ZeRO-3
+    sharded engine."""
+    engine = _make_engine(zero_stage=3, dtype="bf16")
+    engine.train_batch(random_tokens(16))
+    sd = engine._zero3_consolidated_16bit_state_dict()
+    key = [k for k in sd if k.endswith("layers/wq")][0]
+    assert sd[key].dtype.name == "bfloat16"
+    assert sd[key].shape == engine.state["params"]["layers"]["wq"].shape
+
+    assert engine.save_16bit_model(str(tmp_path))
+    import torch
+
+    loaded = torch.load(str(tmp_path / "model_weights.pt"), weights_only=True)
+    t = loaded[key]
+    assert t.dtype == torch.bfloat16
+    np.testing.assert_allclose(
+        t.float().numpy(), np.asarray(sd[key]).astype(np.float32), rtol=1e-6)
+
+
+def test_pjit_matches_single_device_loss():
+    """Determinism sanitizer (SURVEY §5): the 8-device pjit loss equals the
+    same computation on one device — the compiled SPMD program introduces no
+    numerical divergence beyond reduction order."""
+    model = tiny_transformer()
+    params = model.init(jax.random.PRNGKey(0))
+    batch = random_tokens(16)
+    single = float(jax.jit(model.loss)(params, batch))
+
+    engine = _make_engine(zero_stage=2)
+    # replace engine params with the reference init for an exact comparison
+    engine.state["params"] = jax.jit(
+        lambda p: p, out_shardings=engine._state_shardings["params"])(params)
+    dist_loss = float(engine.eval_batch(batch))
+    np.testing.assert_allclose(dist_loss, single, rtol=2e-5)

@@ -167,3 +167,29 @@ def test_sparse_attention_utils_pad_unpad():
     ext = SparseAttentionUtils.extend_position_embedding(pos, 1024)
     assert ext.shape == (1024, 4)
     np.testing.assert_allclose(np.asarray(ext[512:]), np.asarray(pos))
+
+
+def test_sparse_self_attention_2d_key_mask_excludes_padding():
+    """A [B, S] 0/1 BERT-style attn_mask must actually exclude padded keys
+    (converted to additive, not added raw)."""
+    from deepspeed_tpu.ops.sparse_attention import (
+        FixedSparsityConfig,
+        SparseSelfAttention,
+    )
+
+    B, S, H, D = 2, 64, 2, 16
+    attn = SparseSelfAttention(
+        FixedSparsityConfig(num_heads=H, block=32, num_local_blocks=2), causal=False)
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+               for _ in range(3))
+    mask = np.ones((B, S), np.float32)
+    mask[:, S // 2:] = 0
+    out = attn.apply(q, k, v, attn_mask=mask)
+    # perturbing masked-out keys' values must not change the output
+    v2 = v.at[:, S // 2:].add(100.0)
+    out2 = attn.apply(q, k, v2, attn_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), rtol=1e-5, atol=1e-5)
+    # and it matches the key_padding_mask spelling
+    out_kp = attn.apply(q, k, v, key_padding_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_kp), rtol=1e-5, atol=1e-5)
