@@ -35,6 +35,7 @@ from .mesh import (
     MeshConfig,
     axis_size,
     batch_sharding,
+    build_hybrid_mesh,
     build_mesh,
     data_parallel_size,
     named_sharding,

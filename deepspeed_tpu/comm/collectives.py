@@ -100,13 +100,21 @@ def mpi_discovery(port: int = 29500) -> Optional[dict]:
     elif "MASTER_ADDR" in env:
         coordinator = f"{env['MASTER_ADDR']}:{env.get('MASTER_PORT', port)}"
     else:
-        if size > 1:
-            # guessing each rank's own hostname would point every node's
-            # rendezvous at itself and hang jax.distributed.initialize
+        local = None
+        for lk in ("OMPI_COMM_WORLD_LOCAL_SIZE", "MPI_LOCALNRANKS", "MV2_COMM_WORLD_LOCAL_SIZE"):
+            if lk in env:
+                local = int(env[lk])
+                break
+        if size > 1 and local != size:
+            # multi-host (or unknown): guessing each rank's own hostname
+            # would point every node's rendezvous at itself and hang
+            # jax.distributed.initialize
             raise RuntimeError(
                 "mpi_discovery: MPI rank env found but no MASTER_ADDR / "
                 "AZ_BATCH_MASTER_NODE — export MASTER_ADDR=<rank-0 host> "
                 "(mpirun -x MASTER_ADDR=...) for multi-node runs")
+        # single process, or all ranks on this host: every rank resolves the
+        # same machine
         import socket
 
         coordinator = f"{socket.gethostname()}:{port}"

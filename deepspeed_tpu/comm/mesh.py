@@ -96,6 +96,63 @@ def build_mesh(
     return mesh
 
 
+def build_hybrid_mesh(
+    config: Optional[MeshConfig] = None,
+    dcn_axes: Sequence[str] = ("pipe", "data"),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Multi-slice mesh: ``dcn_axes`` span slices (data-center network),
+    everything else stays inside a slice (ICI).
+
+    This is the "DCN vs ICI hierarchy" recipe (SURVEY §5 / §2.3): the
+    reference hand-assigns ranks so NCCL's slow links carry only DP traffic;
+    here ``mesh_utils.create_hybrid_device_mesh`` orders devices so the outer
+    axes change across slice boundaries and XLA routes those collectives over
+    DCN. Falls back to ``build_mesh`` on single-slice (or CPU) topologies,
+    where the distinction does not exist.
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    slice_ids = {getattr(d, "slice_index", 0) or 0 for d in devices}
+    n_slices = len(slice_ids)
+    if n_slices <= 1:
+        return build_mesh(config, devices)
+    sizes = config.sizes(len(devices))
+    # factor each DCN axis into a cross-slice component (their product must
+    # equal n_slices — create_hybrid_device_mesh's contract) and a
+    # within-slice remainder that stays on ICI: data=8 over 2 slices becomes
+    # dcn 2 x ici 4
+    rem_slices = n_slices
+    dcn_shape = []
+    ici_shape = []
+    for a in AXIS_ORDER:
+        if a in dcn_axes and sizes[a] > 1:
+            cross = math.gcd(sizes[a], rem_slices)
+            rem_slices //= cross
+            dcn_shape.append(cross)
+            ici_shape.append(sizes[a] // cross)
+        else:
+            dcn_shape.append(1)
+            ici_shape.append(sizes[a])
+    if rem_slices != 1:
+        raise ValueError(
+            f"dcn axes {tuple(dcn_axes)} sizes cannot cover {n_slices} slices "
+            f"(mesh {sizes}); enlarge a dcn axis or pass different dcn_axes")
+    from jax.experimental import mesh_utils
+
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=tuple(ici_shape),
+        dcn_mesh_shape=tuple(dcn_shape),
+        devices=devices,
+    )
+    mesh = Mesh(dev_array, axis_names=AXIS_ORDER)
+    logger.info(
+        f"built hybrid mesh over {n_slices} slices: dcn={dict(zip(AXIS_ORDER, dcn_shape))} "
+        f"ici={dict(zip(AXIS_ORDER, ici_shape))}")
+    _CURRENT_MESH[0] = mesh
+    return mesh
+
+
 def single_device_mesh() -> Mesh:
     return Mesh(np.asarray(jax.devices()[:1]).reshape((1,) * len(AXIS_ORDER)), AXIS_ORDER)
 

@@ -384,6 +384,11 @@ def _attention_dispatch(cfg: TransformerConfig):
         from ..parallel.ring_attention import ring_attention_sharded
 
         return lambda q, k, v, bias: ring_attention_sharded(q, k, v, mesh=_ACTIVE_MESH[0])
+    if cfg.attn_impl == "ulysses":
+        from ..parallel.ulysses import ulysses_attention_sharded
+
+        return lambda q, k, v, bias: ulysses_attention_sharded(
+            q, k, v, mesh=_ACTIVE_MESH[0], causal=cfg.causal)
     if cfg.attn_impl == "sparse":
         from ..ops.sparse_attention import SPARSITY_CONFIGS, sparse_flash_attention
 

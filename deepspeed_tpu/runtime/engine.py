@@ -220,9 +220,17 @@ class DeepSpeedEngine:
         # stream, the host-side update, and the h2d bf16 param copy-back —
         # the role the reference's cpu_adam kernel + custom CUDA copy play.
         off_opt = self.config.zero_optimization.offload_optimizer
-        self.offload_optimizer_enabled = off_opt.device in ("cpu", "nvme")
-        if off_opt.device == "nvme":
-            logger.warning("offload_optimizer device 'nvme' tiers to host memory on TPU-VM")
+        # cpu tier: host-memory states, update compiled as a host region.
+        # nvme tier (ZeRO-Infinity): states live on DISK through the native
+        # aio engine and the step happens on host over swapped groups
+        # (runtime/zero/nvme_optimizer.py) — the compiled program is
+        # grads-only in that mode.
+        self._nvme_offload = off_opt.device == "nvme"
+        self.offload_optimizer_enabled = off_opt.device == "cpu"
+        if self._nvme_offload and self.config.fp16.enabled:
+            raise NotImplementedError(
+                "offload_optimizer device 'nvme' with fp16 dynamic loss "
+                "scaling is not supported; use bf16")
         off_param = self.config.zero_optimization.offload_param
         if off_param.device != "none":
             raise NotImplementedError(
@@ -308,6 +316,11 @@ class DeepSpeedEngine:
             opt_state = jax.jit(
                 partial(onebit_init, dp=dp), out_shardings=opt_shardings
             )(params)
+        elif self._nvme_offload:
+            # states live on NVMe (nvme_optimizer); nothing on device
+            self.opt_specs = {}
+            opt_shardings = {}
+            opt_state = {}
         else:
             opt_state_shape = jax.eval_shape(self.opt_init, shapes)
             self.opt_specs = self._mirror_opt_specs(opt_state_shape)
@@ -352,6 +365,49 @@ class DeepSpeedEngine:
             self.state["params"] = params16
             self.state["master"] = master
             self._state_shardings["master"] = master_shardings
+        elif self._nvme_offload:
+            # build the NVMe-tiered optimizer from the fp32 init, then keep
+            # only the compute-dtype working copy on device
+            from .zero.nvme_optimizer import NvmeTieredOptimizer
+
+            if opt_type not in ("adam", "adamw", "fusedadam", "cpuadam"):
+                raise NotImplementedError(
+                    f"nvme offload supports Adam(W) (the reference swaps Adam "
+                    f"states too), not {opt_type!r}")
+            aio = self.config.aio
+            self._nvme_treedef = jax.tree_util.tree_structure(self.state["params"])
+            self._nvme_keys = []
+            params_host = {}
+            for path, leaf in jax.tree_util.tree_flatten_with_path(self.state["params"])[0]:
+                key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+                self._nvme_keys.append(key)
+                params_host[key] = np.asarray(jax.device_get(leaf))
+            opt_kwargs = dict(opt_cfg.params)
+            if "betas" in opt_kwargs:
+                opt_kwargs["betas"] = tuple(opt_kwargs["betas"])
+            # same decay semantics as the on-device path (ops/optimizers.py):
+            # 'adam' = L2 in the gradient, 'adamw' = decoupled decay
+            opt_kwargs.setdefault("adam_w_mode", opt_type == "adamw")
+            self.nvme_opt = NvmeTieredOptimizer(
+                params_host,
+                swap_dir=off_opt.nvme_path,
+                sub_group_bytes=int(self.config.zero_optimization.sub_group_size),
+                n_threads=aio.thread_count or 4,
+                **{k: v for k, v in opt_kwargs.items()
+                   if k in ("lr", "betas", "eps", "weight_decay", "adam_w_mode")},
+            )
+            cdt = self.config.compute_dtype
+            params16 = jax.jit(
+                lambda p: jax.tree.map(
+                    lambda x: x.astype(cdt) if x.dtype == jnp.float32 else x, p
+                ),
+                out_shardings=param_shardings,
+            )(self.state["params"])
+            self.state["params"] = params16
+            logger.info(
+                "NVMe-tiered optimizer: %.2f GB of states in %s across %d groups",
+                self.nvme_opt.state_bytes() / 1e9, off_opt.nvme_path,
+                self.nvme_opt.num_groups)
 
         # MoQ / quantize-aware training (reference: runtime/quantize.py +
         # compression/scheduler.py): step-scheduled fake-quant of the weights.
@@ -359,10 +415,10 @@ class DeepSpeedEngine:
 
         qsc = QuantScheduleConfig.from_ds_config(raw if isinstance(raw, dict) else {})
         self.quant_scheduler = CompressionScheduler(qsc) if qsc.enabled else None
-        if self.quant_scheduler and self.offload_optimizer_enabled:
+        if self.quant_scheduler and (self.offload_optimizer_enabled or self._nvme_offload):
             raise NotImplementedError(
                 "quantize-during-training with offload_optimizer is unsupported "
-                "(the fake-quant must hit the host master weights)"
+                "(the fake-quant must hit the host/NVMe master weights)"
             )
         self._quant_fns: dict[int, Any] = {}
 
@@ -723,7 +779,7 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # Fused train step
     # ------------------------------------------------------------------
-    def _build_train_step(self):
+    def _build_train_step(self, grads_only: bool = False):
         if self._onebit_cfg is not None:
             return self._build_onebit_train_step()
         cfg = self.config
@@ -788,6 +844,18 @@ class DeepSpeedEngine:
 
             step1 = state["step"] + 1
             lr = self.lr_schedule(step1)
+            if grads_only:
+                # NVMe-tier mode: the optimizer step happens on host over
+                # swapped states (runtime/zero/nvme_optimizer.py); the
+                # compiled program ends at clipped grads
+                metrics = {
+                    "loss": loss,
+                    "grad_norm": gnorm,
+                    "lr": lr,
+                    "loss_scale": loss_scale,
+                    "overflow": ~finite,
+                }
+                return grads, metrics
             new_params, new_opt, extras = apply_update(state, grads, finite, step1, lr)
 
             # fp16 dynamic loss scaling (reference: runtime/fp16/loss_scaler.py
@@ -819,6 +887,11 @@ class DeepSpeedEngine:
             }
             return new_state, metrics
 
+        if grads_only:
+            return jax.jit(
+                train_step,
+                in_shardings=(self._state_shardings, NamedSharding(mesh, batch_spec)),
+            )
         return self._jit_step(train_step, batch_spec)
 
     def _jit_step(self, train_step, batch_spec):
@@ -849,6 +922,8 @@ class DeepSpeedEngine:
         time 5:1 on a tunneled chip (experiments/perf_probe4.py) — steps chain
         asynchronously instead, and overflow accounting catches up lazily.
         """
+        if self._nvme_offload:
+            return self._train_batch_nvme(batch)
         if self._train_step is None:
             self._train_step = self._build_train_step()
         if self.curriculum_scheduler is not None:
@@ -914,6 +989,52 @@ class DeepSpeedEngine:
                     res, detailed=self.config.flops_profiler.detailed)
         except Exception as e:  # noqa: BLE001 — profiling must not kill training
             logger.warning(f"flops profiler failed: {e}")
+
+    def _train_batch_nvme(self, batch: dict) -> dict:
+        """ZeRO-Infinity step: compiled grads-only program -> host-side Adam
+        over NVMe-swapped state groups -> compute-dtype params back to device.
+        Checkpoint contract: the engine checkpoint carries params + the Adam
+        step clock (client_state); on load the tier's masters are rebuilt
+        from the restored params and moments restart from zero
+        (nvme_opt.reset_from) — moments are NOT part of the checkpoint."""
+        if self._train_step is None:
+            self._train_step = self._build_train_step(grads_only=True)
+        if self.curriculum_scheduler is not None:
+            batch = self._apply_curriculum(batch)
+        self.tput_timer.start()
+        grads, metrics = self._train_step(self.state, batch)
+        metrics = jax.device_get(metrics)
+        overflow = bool(np.asarray(metrics["overflow"]))
+        lr = float(np.asarray(metrics["lr"]))
+        grads_host = {}
+        for key, (path, leaf) in zip(
+            self._nvme_keys, jax.tree_util.tree_flatten_with_path(grads)[0]
+        ):
+            grads_host[key] = np.asarray(jax.device_get(leaf))
+        new_master = self.nvme_opt.step(grads_host, lr=lr, skip=overflow)
+        cdt = self.config.compute_dtype
+        leaves16 = [
+            jnp.asarray(new_master[k]).astype(cdt) for k in self._nvme_keys
+        ]
+        params16 = jax.tree_util.tree_unflatten(self._nvme_treedef, leaves16)
+        params16 = jax.jit(lambda p: p, out_shardings=self._state_shardings["params"])(
+            params16)
+        self.state["params"] = params16
+        self.state["step"] = self.state["step"] + jnp.int32(0 if overflow else 1)
+        if overflow:
+            self.state["skipped"] = self.state["skipped"] + 1
+        self.tput_timer.stop()
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size
+        if self.global_steps % self.config.steps_per_print == 0:
+            self._report_progress(metrics)
+        self.monitor.write_events(
+            [
+                ("Train/Samples/train_loss", float(metrics["loss"]), self.global_samples),
+                ("Train/Samples/lr", float(metrics["lr"]), self.global_samples),
+            ]
+        )
+        return metrics
 
     def _maybe_quantize_weights(self):
         """MoQ: fake-quantize the weight matrices at the scheduled bit-width
@@ -1156,6 +1277,8 @@ class DeepSpeedEngine:
             global_samples=self.global_samples,
             skipped_steps=self.skipped_steps,
         )
+        if self._nvme_offload:
+            extra["nvme_opt_step_count"] = self.nvme_opt.step_count
         eng = self.checkpoint_engine
         eng.save(
             os.path.join(save_dir, tag),
@@ -1254,4 +1377,16 @@ class DeepSpeedEngine:
         self.state = state
         self.global_steps = client_state.get("global_steps", int(jax.device_get(state["step"])))
         self.global_samples = client_state.get("global_samples", 0)
+        if self._nvme_offload:
+            # resync the NVMe tier to the restored weights — its masters were
+            # built from the fresh init and would otherwise overwrite the
+            # loaded params on the next step
+            params_host = {
+                k: np.asarray(jax.device_get(leaf)).astype(np.float32)
+                for k, leaf in zip(
+                    self._nvme_keys,
+                    jax.tree_util.tree_leaves(self.state["params"]))
+            }
+            self.nvme_opt.reset_from(
+                params_host, step_count=client_state.get("nvme_opt_step_count", 0))
         return tag, client_state

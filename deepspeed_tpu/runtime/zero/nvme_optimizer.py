@@ -1,0 +1,145 @@
+"""NVMe-tiered optimizer — the ZeRO-Infinity optimizer-state tier.
+
+Reference: ZeRO-Infinity keeps fp32 master weights + Adam moments on NVMe
+(runtime/swap_tensor/partitioned_optimizer_swapper.py +
+optimizer_utils.py), swapping each parameter group in over the aio engine,
+stepping it on the CPU (csrc/adam/cpu_adam.cpp), and swapping it back out —
+host DRAM holds only one group at a time, so the trainable size is bounded by
+disk, not RAM or HBM.
+
+Same tiering here: leaves are partitioned into byte-bounded groups; per step
+each group's {master, m, v} pytree is read from NVMe through the native aio
+engine (runtime/swap_tensor.TensorSwapper over csrc/aio/dstpu_aio.cpp),
+updated with vectorized numpy Adam (the AVX role of cpu_adam), and written
+back with an fsync barrier. The device keeps ONLY the compute-dtype params;
+the engine's NVMe mode (runtime/engine.py) compiles a grads-only step and
+feeds this optimizer on host.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import numpy as np
+
+PyTree = Any
+
+
+class NvmeTieredOptimizer:
+    def __init__(
+        self,
+        params_host: dict[str, np.ndarray],
+        lr: float = 1e-3,
+        betas: tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        adam_w_mode: bool = True,
+        swap_dir: str = "/tmp/dstpu_nvme",
+        sub_group_bytes: int = 1 << 28,  # 256 MB of fp32 master per group
+        n_threads: int = 4,
+        **_ignored,
+    ):
+        from ..swap_tensor import TensorSwapper
+
+        self.lr = float(lr)
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.wd = weight_decay
+        self.adam_w = adam_w_mode
+        self.step_count = 0
+        self.swapper = TensorSwapper(swap_dir, n_threads=n_threads)
+
+        # partition leaves into byte-bounded groups (reference sub_group_size)
+        self.groups: list[list[str]] = []
+        cur: list[str] = []
+        cur_bytes = 0
+        for key, p in params_host.items():
+            nbytes = int(np.prod(p.shape)) * 4
+            if cur and cur_bytes + nbytes > sub_group_bytes:
+                self.groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(key)
+            cur_bytes += nbytes
+        if cur:
+            self.groups.append(cur)
+
+        # materialize fp32 master + zero moments per group, tier to NVMe
+        self.manifests: list[dict] = []
+        for g in self.groups:
+            tree = {
+                k: {"master": np.asarray(params_host[k], np.float32),
+                    "m": np.zeros(params_host[k].shape, np.float32),
+                    "v": np.zeros(params_host[k].shape, np.float32)}
+                for k in g
+            }
+            self.manifests.append(self.swapper.swap_out(tree))
+        self.swapper.synchronize()
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    def reset_from(self, params_host: dict[str, np.ndarray], step_count: int = 0):
+        """Resync the tier after a checkpoint load: masters rebuilt from the
+        restored params, moments zeroed (the engine checkpoint does not carry
+        the NVMe moment files), Adam bias-correction clock restored."""
+        old = self.manifests
+        self.manifests = []
+        for g in self.groups:
+            tree = {
+                k: {"master": np.asarray(params_host[k], np.float32),
+                    "m": np.zeros(params_host[k].shape, np.float32),
+                    "v": np.zeros(params_host[k].shape, np.float32)}
+                for k in g
+            }
+            self.manifests.append(self.swapper.swap_out(tree))
+        self.swapper.synchronize()
+        for m in old:
+            self.swapper.release(m)
+        self.step_count = int(step_count)
+
+    def step(self, grads_host: dict[str, np.ndarray], lr: Optional[float] = None,
+             skip: bool = False) -> dict[str, np.ndarray]:
+        """One optimizer step over all groups; returns the updated fp32
+        params (caller casts/uploads). ``skip`` (overflow) still counts the
+        step but leaves states untouched."""
+        lr = self.lr if lr is None else float(lr)
+        if not skip:
+            self.step_count += 1
+        t = max(1, self.step_count)
+        bc1 = 1.0 - self.b1 ** t
+        bc2 = 1.0 - self.b2 ** t
+        out: dict[str, np.ndarray] = {}
+        for gi, manifest in enumerate(self.manifests):
+            tree = self.swapper.swap_in(manifest)
+            for key in self.groups[gi]:
+                st = tree[key]
+                if skip:
+                    out[key] = st["master"]
+                    continue
+                g = np.asarray(grads_host[key], np.float32)
+                if self.wd and not self.adam_w:
+                    g = g + self.wd * st["master"]  # plain Adam: L2 in the grad
+                st["m"] = self.b1 * st["m"] + (1.0 - self.b1) * g
+                st["v"] = self.b2 * st["v"] + (1.0 - self.b2) * g * g
+                update = (st["m"] / bc1) / (np.sqrt(st["v"] / bc2) + self.eps)
+                if self.wd and self.adam_w:
+                    update = update + self.wd * st["master"]  # decoupled decay
+                st["master"] = st["master"] - lr * update
+                out[key] = st["master"]
+            if not skip:
+                old = manifest
+                self.manifests[gi] = self.swapper.swap_out(tree)
+                self.swapper.synchronize()
+                self.swapper.release(old)
+        return out
+
+    def state_bytes(self) -> int:
+        return sum(
+            3 * 4 * int(np.prod(np.asarray(e["shape"])))
+            for m in self.manifests for e in m["entries"]
+        )
+
+    def close(self):
+        self.swapper.close()

@@ -157,3 +157,85 @@ def test_mpi_discovery_multinode_requires_master_addr(monkeypatch):
     monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
     assert mpi_discovery() == {"rank": 1, "world_size": 2,
                                "coordinator": "10.0.0.1:29500"}
+
+
+def test_hybrid_mesh_falls_back_single_slice():
+    """build_hybrid_mesh on a single-slice (CPU) topology = plain build_mesh;
+    multi-slice ordering needs hardware with slice_index and is exercised by
+    the driver's multichip dryrun + real pods."""
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_hybrid_mesh
+
+    mesh = build_hybrid_mesh(MeshConfig(data=2, fsdp=2, model=2))
+    assert dict(mesh.shape) == {"pipe": 1, "data": 2, "fsdp": 2,
+                                "context": 1, "model": 2}
+
+
+def test_hybrid_mesh_multislice_device_order():
+    """Simulated 2-slice topology: the dcn axis (data) must change across
+    slices — every (fsdp, model, ...) column stays within one slice."""
+    import types
+
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_hybrid_mesh
+
+    real = jax.devices()
+
+    class FakeDev:
+        def __init__(self, d, idx, slice_index):
+            self._d = d
+            self.id = idx
+            self.slice_index = slice_index
+            self.process_index = slice_index
+            self.platform = d.platform
+            self.device_kind = d.device_kind
+
+        def __repr__(self):
+            return f"fake(id={self.id}, slice={self.slice_index})"
+
+    fakes = [FakeDev(real[i], i, i // 4) for i in range(8)]
+    mesh = build_hybrid_mesh(MeshConfig(data=2, fsdp=2, model=2), devices=fakes)
+    arr = np.asarray(mesh.devices.tolist())
+    # data is axis 'data' (index 1 of AXIS_ORDER): slices must differ across it
+    data_axis = list(mesh.axis_names).index("data")
+    moved = np.moveaxis(np.vectorize(lambda d: d.slice_index)(mesh.devices), data_axis, 0)
+    assert (moved[0] != moved[1]).all() or (moved[0] == 0).all() and (moved[1] == 1).all()
+    # and within a data index, the slice is constant
+    assert len(set(moved[0].ravel().tolist())) == 1
+    assert len(set(moved[1].ravel().tolist())) == 1
+
+
+def test_mpi_discovery_single_node_local_size(monkeypatch):
+    """All ranks on one host (LOCAL_SIZE == SIZE): hostname fallback is safe
+    and must not raise even without MASTER_ADDR."""
+    from deepspeed_tpu.comm.collectives import mpi_discovery
+
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "4")
+    monkeypatch.delenv("MASTER_ADDR", raising=False)
+    d = mpi_discovery()
+    assert d["world_size"] == 4 and ":" in d["coordinator"]
+
+
+def test_hybrid_mesh_factors_dcn_axis_over_slices():
+    """data=8 over 2 slices: dcn component 2, within-slice remainder 4."""
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_hybrid_mesh
+
+    real = jax.devices()
+
+    class FakeDev:
+        def __init__(self, d, idx, slice_index):
+            self.id = idx
+            self.slice_index = slice_index
+            self.process_index = slice_index
+            self.platform = d.platform
+            self.device_kind = d.device_kind
+
+        def __repr__(self):
+            return f"fake(id={self.id}, slice={self.slice_index})"
+
+    fakes = [FakeDev(real[i], i, i // 4) for i in range(8)]
+    mesh = build_hybrid_mesh(MeshConfig(data=8), devices=fakes)
+    assert dict(mesh.shape)["data"] == 8
+    # each half of the data axis lives in one slice
+    slices = np.vectorize(lambda d: d.slice_index)(mesh.devices).ravel()
+    assert sorted(set(slices.tolist())) == [0, 1]
