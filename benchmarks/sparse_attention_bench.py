@@ -42,6 +42,11 @@ def main():
     ap.add_argument("--dim", type=int, default=64)
     ap.add_argument("--mode", default="bigbird",
                     choices=["fixed", "bigbird", "bslongformer"])
+    # sparsity-pattern granularity. Grid-step cost lessons from the flash
+    # block sweep (docs/PERF.md finding #1) apply here too: 128-blocks at 8k
+    # sequence make ~2 MFLOP grid steps and the kernel loses to dense flash's
+    # 512x1024 tiles despite 8x less math — 512-blocks amortize the grid.
+    ap.add_argument("--block", type=int, default=None)
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
@@ -54,7 +59,7 @@ def main():
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
     from deepspeed_tpu.ops.sparse_attention import SPARSITY_CONFIGS, sparse_flash_attention
 
-    kwargs = {"num_heads": H, "block": 128}
+    kwargs = {"num_heads": H, "block": args.block or (512 if on_tpu else 128)}
     if args.mode == "bigbird":
         kwargs.update(num_random_blocks=2, num_sliding_window_blocks=3, num_global_blocks=1)
     elif args.mode == "bslongformer":

@@ -387,8 +387,14 @@ def _attention_dispatch(cfg: TransformerConfig):
     if cfg.attn_impl == "ulysses":
         from ..parallel.ulysses import ulysses_attention_sharded
 
-        return lambda q, k, v, bias: ulysses_attention_sharded(
-            q, k, v, mesh=_ACTIVE_MESH[0], causal=cfg.causal)
+        # additive bias (alibi/local windows) is not plumbed through the
+        # all-to-all re-sharding — those layers take the dense XLA path,
+        # mirroring the flash dispatch above
+        return lambda q, k, v, bias: (
+            ulysses_attention_sharded(q, k, v, mesh=_ACTIVE_MESH[0], causal=cfg.causal)
+            if bias is None
+            else xla_attention(q, k, v, bias=bias, causal=cfg.causal)
+        )
     if cfg.attn_impl == "sparse":
         from ..ops.sparse_attention import SPARSITY_CONFIGS, sparse_flash_attention
 

@@ -29,6 +29,7 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 NEG_INF = -1e30
+LANES = 128  # lane-broadcast tiling for row statistics (same as flash kernel)
 
 
 def _interpret_default() -> bool:
@@ -109,7 +110,8 @@ def _fwd_kernel(k_list_ref, k_count_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_scr[:, 0:1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, 0:1] + jnp.log(l_safe))[:, 0]
+        lse_ref[0] = jnp.broadcast_to(
+            m_scr[:, 0:1] + jnp.log(l_safe), lse_ref.shape[1:])
 
 
 def _sparse_forward(q, k, v, k_lists, k_counts, sm_scale, causal, block, interpret):
@@ -129,7 +131,7 @@ def _sparse_forward(q, k, v, k_lists, k_counts, sm_scale, causal, block, interpr
         ],
         out_specs=[
             pl.BlockSpec((1, block, D), lambda bh, qi, a, kl, kc: (bh, qi, 0)),
-            pl.BlockSpec((1, block), lambda bh, qi, a, kl, kc: (bh, qi)),
+            pl.BlockSpec((1, block, LANES), lambda bh, qi, a, kl, kc: (bh, qi, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block, block), jnp.float32),
@@ -142,11 +144,11 @@ def _sparse_forward(q, k, v, k_lists, k_counts, sm_scale, causal, block, interpr
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, LANES), jnp.float32),
         ],
         interpret=interpret,
     )(k_lists, k_counts, q, k, v)
-    return out, lse
+    return out, lse[..., 0]  # de-broadcast the lane-tiled row statistic
 
 
 def _dq_kernel(k_list_ref, k_count_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -165,8 +167,8 @@ def _dq_kernel(k_list_ref, k_count_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, None]    # [block, 1]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, 0:1]     # lane-tiled [block, LANES] -> [block, 1]
+        delta = delta_ref[0][:, 0:1]
         s = sm_scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -205,8 +207,8 @@ def _dkdv_kernel(q_list_ref, q_count_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, 0:1]
+        delta = delta_ref[0][:, 0:1]
         s = sm_scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -239,6 +241,9 @@ def _sparse_backward(res, g, lists, sm_scale, causal, block, interpret):
     nq, max_a = k_lists.shape
     nk, max_aq = q_lists.shape
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [BH,S]
+    # lane-tile the row statistics for the kernels (saved de-broadcast)
+    lse = jnp.broadcast_to(lse[..., None], lse.shape + (LANES,))
+    delta = jnp.broadcast_to(delta[..., None], delta.shape + (LANES,))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal, block=block, max_a=max_a),
@@ -250,8 +255,8 @@ def _sparse_backward(res, g, lists, sm_scale, causal, block, interpret):
                 pl.BlockSpec((1, block, D), lambda bh, qi, a, kl, kc: (bh, kl[qi, a], 0)),
                 pl.BlockSpec((1, block, D), lambda bh, qi, a, kl, kc: (bh, kl[qi, a], 0)),
                 pl.BlockSpec((1, block, D), lambda bh, qi, a, kl, kc: (bh, qi, 0)),
-                pl.BlockSpec((1, block), lambda bh, qi, a, kl, kc: (bh, qi)),
-                pl.BlockSpec((1, block), lambda bh, qi, a, kl, kc: (bh, qi)),
+                pl.BlockSpec((1, block, LANES), lambda bh, qi, a, kl, kc: (bh, qi, 0)),
+                pl.BlockSpec((1, block, LANES), lambda bh, qi, a, kl, kc: (bh, qi, 0)),
             ],
             out_specs=pl.BlockSpec((1, block, D), lambda bh, qi, a, kl, kc: (bh, qi, 0)),
             scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
@@ -270,8 +275,8 @@ def _sparse_backward(res, g, lists, sm_scale, causal, block, interpret):
                 pl.BlockSpec((1, block, D), lambda bh, kj, a, ql, qc: (bh, kj, 0)),
                 pl.BlockSpec((1, block, D), lambda bh, kj, a, ql, qc: (bh, kj, 0)),
                 pl.BlockSpec((1, block, D), lambda bh, kj, a, ql, qc: (bh, ql[kj, a], 0)),
-                pl.BlockSpec((1, block), lambda bh, kj, a, ql, qc: (bh, ql[kj, a])),
-                pl.BlockSpec((1, block), lambda bh, kj, a, ql, qc: (bh, ql[kj, a])),
+                pl.BlockSpec((1, block, LANES), lambda bh, kj, a, ql, qc: (bh, ql[kj, a], 0)),
+                pl.BlockSpec((1, block, LANES), lambda bh, kj, a, ql, qc: (bh, ql[kj, a], 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block, D), lambda bh, kj, a, ql, qc: (bh, kj, 0)),

@@ -385,9 +385,11 @@ class DeepSpeedEngine:
             opt_kwargs = dict(opt_cfg.params)
             if "betas" in opt_kwargs:
                 opt_kwargs["betas"] = tuple(opt_kwargs["betas"])
-            # same decay semantics as the on-device path (ops/optimizers.py):
-            # 'adam' = L2 in the gradient, 'adamw' = decoupled decay
-            opt_kwargs.setdefault("adam_w_mode", opt_type == "adamw")
+            # same decay semantics as the on-device path, which derives the
+            # mode from the optimizer NAME and ignores any adam_w_mode key
+            # (ops/optimizers.py get_optimizer pops it): 'adam' = L2 in the
+            # gradient, 'adamw' = decoupled decay
+            opt_kwargs["adam_w_mode"] = opt_type == "adamw"
             self.nvme_opt = NvmeTieredOptimizer(
                 params_host,
                 swap_dir=off_opt.nvme_path,
@@ -404,6 +406,9 @@ class DeepSpeedEngine:
                 out_shardings=param_shardings,
             )(self.state["params"])
             self.state["params"] = params16
+            # per-step param uploader, compiled ONCE (a fresh lambda per step
+            # would miss the jit cache and recompile every step)
+            self._nvme_upload = jax.jit(lambda p: p, out_shardings=param_shardings)
             logger.info(
                 "NVMe-tiered optimizer: %.2f GB of states in %s across %d groups",
                 self.nvme_opt.state_bytes() / 1e9, off_opt.nvme_path,
@@ -1012,14 +1017,13 @@ class DeepSpeedEngine:
         ):
             grads_host[key] = np.asarray(jax.device_get(leaf))
         new_master = self.nvme_opt.step(grads_host, lr=lr, skip=overflow)
-        cdt = self.config.compute_dtype
-        leaves16 = [
-            jnp.asarray(new_master[k]).astype(cdt) for k in self._nvme_keys
-        ]
-        params16 = jax.tree_util.tree_unflatten(self._nvme_treedef, leaves16)
-        params16 = jax.jit(lambda p: p, out_shardings=self._state_shardings["params"])(
-            params16)
-        self.state["params"] = params16
+        if new_master is not None:  # skipped steps touch neither disk nor device
+            cdt = self.config.compute_dtype
+            leaves16 = [
+                jnp.asarray(new_master[k]).astype(cdt) for k in self._nvme_keys
+            ]
+            params16 = jax.tree_util.tree_unflatten(self._nvme_treedef, leaves16)
+            self.state["params"] = self._nvme_upload(params16)
         self.state["step"] = self.state["step"] + jnp.int32(0 if overflow else 1)
         if overflow:
             self.state["skipped"] = self.state["skipped"] + 1

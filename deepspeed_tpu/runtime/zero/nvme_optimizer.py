@@ -100,14 +100,16 @@ class NvmeTieredOptimizer:
         self.step_count = int(step_count)
 
     def step(self, grads_host: dict[str, np.ndarray], lr: Optional[float] = None,
-             skip: bool = False) -> dict[str, np.ndarray]:
+             skip: bool = False) -> Optional[dict[str, np.ndarray]]:
         """One optimizer step over all groups; returns the updated fp32
-        params (caller casts/uploads). ``skip`` (overflow) still counts the
-        step but leaves states untouched."""
+        params (caller casts/uploads). ``skip`` (overflow) returns None
+        without touching disk — states and the step clock are unchanged, and
+        the caller keeps its current params."""
+        if skip:
+            return None
         lr = self.lr if lr is None else float(lr)
-        if not skip:
-            self.step_count += 1
-        t = max(1, self.step_count)
+        self.step_count += 1
+        t = self.step_count
         bc1 = 1.0 - self.b1 ** t
         bc2 = 1.0 - self.b2 ** t
         out: dict[str, np.ndarray] = {}
@@ -115,9 +117,6 @@ class NvmeTieredOptimizer:
             tree = self.swapper.swap_in(manifest)
             for key in self.groups[gi]:
                 st = tree[key]
-                if skip:
-                    out[key] = st["master"]
-                    continue
                 g = np.asarray(grads_host[key], np.float32)
                 if self.wd and not self.adam_w:
                     g = g + self.wd * st["master"]  # plain Adam: L2 in the grad
@@ -128,11 +127,10 @@ class NvmeTieredOptimizer:
                     update = update + self.wd * st["master"]  # decoupled decay
                 st["master"] = st["master"] - lr * update
                 out[key] = st["master"]
-            if not skip:
-                old = manifest
-                self.manifests[gi] = self.swapper.swap_out(tree)
-                self.swapper.synchronize()
-                self.swapper.release(old)
+            old = manifest
+            self.manifests[gi] = self.swapper.swap_out(tree)
+            self.swapper.synchronize()
+            self.swapper.release(old)
         return out
 
     def state_bytes(self) -> int:

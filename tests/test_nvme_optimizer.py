@@ -52,8 +52,11 @@ def test_nvme_optimizer_skip_leaves_states(tmp_path):
     params = {"w": np.ones((4, 4), np.float32)}
     opt = NvmeTieredOptimizer(dict(params), lr=0.1, swap_dir=str(tmp_path))
     out = opt.step({"w": np.ones((4, 4), np.float32)}, skip=True)
-    np.testing.assert_allclose(out["w"], params["w"])  # untouched on overflow
+    assert out is None  # overflow: no disk IO, caller keeps current params
     assert opt.step_count == 0
+    # a following real step proceeds from the untouched states
+    out2 = opt.step({"w": np.zeros((4, 4), np.float32)})
+    np.testing.assert_allclose(out2["w"], params["w"])  # zero grad, no decay
     opt.close()
 
 
