@@ -62,6 +62,10 @@ def main():
         # and beats save_flash@micro64 by ~7% (99.2k vs 92.8k tok/s)
         remat_policy="dots_and_flash" if on_tpu else "save_flash",
         attn_impl="flash" if on_tpu else "xla",
+        # experiments/perf_probe5.py: 1024x1024 beats the auto 512/1024 cap
+        # by ~1.6% at these shapes (the whole 1k sequence in one k-block)
+        flash_block_q=1024 if on_tpu else 0,
+        flash_block_k=1024 if on_tpu else 0,
     )
     model = Model(cfg)
     ds_cfg = {

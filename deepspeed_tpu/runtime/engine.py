@@ -389,7 +389,14 @@ class DeepSpeedEngine:
             # mode from the optimizer NAME and ignores any adam_w_mode key
             # (ops/optimizers.py get_optimizer pops it): 'adam' = L2 in the
             # gradient, 'adamw' = decoupled decay
-            opt_kwargs["adam_w_mode"] = opt_type == "adamw"
+            name_mode = opt_type == "adamw"
+            if opt_kwargs.get("adam_w_mode", name_mode) != name_mode:
+                logger.warning(
+                    "optimizer.params.adam_w_mode=%s contradicts type %r and is "
+                    "ignored (decay mode follows the optimizer name on every "
+                    "path); use type 'adamw' for decoupled decay",
+                    opt_kwargs["adam_w_mode"], opt_cfg.type)
+            opt_kwargs["adam_w_mode"] = name_mode
             self.nvme_opt = NvmeTieredOptimizer(
                 params_host,
                 swap_dir=off_opt.nvme_path,
@@ -1011,12 +1018,15 @@ class DeepSpeedEngine:
         metrics = jax.device_get(metrics)
         overflow = bool(np.asarray(metrics["overflow"]))
         lr = float(np.asarray(metrics["lr"]))
-        grads_host = {}
-        for key, (path, leaf) in zip(
-            self._nvme_keys, jax.tree_util.tree_flatten_with_path(grads)[0]
-        ):
-            grads_host[key] = np.asarray(jax.device_get(leaf))
-        new_master = self.nvme_opt.step(grads_host, lr=lr, skip=overflow)
+        if overflow:
+            new_master = None  # skip without paying the d2h gradient fetch
+        else:
+            grads_host = {}
+            for key, (path, leaf) in zip(
+                self._nvme_keys, jax.tree_util.tree_flatten_with_path(grads)[0]
+            ):
+                grads_host[key] = np.asarray(jax.device_get(leaf))
+            new_master = self.nvme_opt.step(grads_host, lr=lr)
         if new_master is not None:  # skipped steps touch neither disk nor device
             cdt = self.config.compute_dtype
             leaves16 = [

@@ -1,0 +1,50 @@
+"""Probe: flash block sizes under the autotuned config (dots_and_flash,
+micro 32) — is 1024x1024 better than the auto 512/1024 cap at bench shapes?"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+L, H, D, V, S, B = 12, 12, 768, 50304, 1024, 64
+
+
+def run(bq, bk):
+    cfg = TransformerConfig(
+        vocab_size=V, max_seq_len=S, num_layers=L, num_heads=H, hidden_size=D,
+        pos_emb="learned", dtype=jnp.bfloat16, remat=True,
+        remat_policy="dots_and_flash", attn_impl="flash",
+        flash_block_q=bq, flash_block_k=bk)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=Model(cfg), config={
+        "train_batch_size": B, "train_micro_batch_size_per_gpu": B // 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 1}, "bf16": {"enabled": True},
+        "gradient_clipping": 1.0, "steps_per_print": 10**9, "mesh": {"data": -1}})
+    toks = np.random.default_rng(0).integers(0, V, (B, S + 1)).astype(np.int32)
+    batch = {"tokens": toks}
+    m = engine.train_batch(batch)
+    np.asarray(jax.device_get(m["loss"]))
+    for _ in range(3):
+        m = engine.train_batch(batch)
+    np.asarray(jax.device_get(m["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        m = engine.train_batch(batch)
+    np.asarray(jax.device_get(m["loss"]))
+    dt = (time.perf_counter() - t0) / 10
+    tok_s = B * S / dt
+    print(f"blocks {bq or 'auto'}x{bk or 'auto'}: {dt*1e3:.0f} ms/step, {tok_s:,.0f} tok/s",
+          flush=True)
+    return tok_s
+
+
+run(0, 0)       # auto (512/1024 cap)
+run(1024, 1024)
+run(512, 512)
