@@ -223,7 +223,14 @@ def get_optimizer(name: str, params_cfg: dict):
     kwargs = dict(params_cfg)
     lr = kwargs.pop("lr", 1e-3)
     kwargs.pop("torch_adam", None)
-    kwargs.pop("adam_w_mode", None)
+    awm = kwargs.pop("adam_w_mode", None)
+    if awm is not None and bool(awm) != (name == "adamw"):
+        from ..utils.logging import logger
+
+        logger.warning(
+            "optimizer.params.adam_w_mode=%s contradicts type %r and is ignored "
+            "(decay mode follows the optimizer name); use type 'adamw' for "
+            "decoupled decay", awm, name)
     kwargs.pop("freeze_step", None)
     kwargs.pop("cuda_aware", None)
     kwargs.pop("comm_backend_name", None)
