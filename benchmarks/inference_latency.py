@@ -92,10 +92,15 @@ def main():
     cache = tfm.init_cache(cfg, B, Smax, dtype=cfg.dtype)
     logits, cache = prefill(params, jnp.asarray(prompt), cache)  # compile
     _sync(logits)
-    t0 = time.perf_counter()
-    logits, cache2 = prefill(params, jnp.asarray(prompt), cache)
-    _sync(logits)
-    prefill_ms = (time.perf_counter() - t0) * 1e3
+    # median of several calls — a single timed call right after compilation
+    # can catch residual backend work and report seconds for a ~10ms program
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        logits, cache2 = prefill(params, jnp.asarray(prompt), cache)
+        _sync(logits)
+        times.append((time.perf_counter() - t0) * 1e3)
+    prefill_ms = float(np.median(times))
 
     tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
     logits1, cache2 = decode(params, tok, cache2, prompt_len)  # compile
@@ -113,10 +118,11 @@ def main():
 
     lat = np.asarray(lat)
 
-    # chained decode: steps dispatched back-to-back, one sync at the end —
-    # the serving path (generation compiles to one scan, strictly faster).
-    # Per-step sync above measures host round-trips too (~75 ms through a
-    # tunneled chip), so it bounds the distribution, not the throughput.
+    # chained decode: steps dispatched back-to-back, one sync at the end.
+    # Still two host dispatches per token (decode + argmax) riding the
+    # dispatch queue — an intermediate between the per-step-sync numbers
+    # above (which also pay a round-trip per token) and the fused generate
+    # below (the actual serving path).
     tok_c = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
     cache_c = cache2
     t0 = time.perf_counter()
@@ -128,16 +134,35 @@ def main():
     _sync(logits1)
     chained_ms = (time.perf_counter() - t0) * 1e3 / args.tokens
 
+    # the serving path: the ENTIRE prefill + decode loop as one compiled
+    # program (InferenceEngine.generate lowers decode to a lax.scan) — one
+    # dispatch for the whole generation, so host/tunnel round-trips are out
+    # of the measurement. Differencing two generation lengths cancels the
+    # prefill + dispatch constant so the metric is per DECODE token, the
+    # same definition chained_ms uses.
+    t_half = args.tokens // 2 or 1
+    eng.generate(prompt, max_new_tokens=args.tokens)   # compile T
+    eng.generate(prompt, max_new_tokens=t_half)        # compile T/2
+    t0 = time.perf_counter()
+    toks_out = eng.generate(prompt, max_new_tokens=args.tokens)
+    t_full = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    eng.generate(prompt, max_new_tokens=t_half)
+    t_short = time.perf_counter() - t0
+    fused_ms = (t_full - t_short) * 1e3 / (args.tokens - t_half)
+    assert toks_out.shape == (B, args.tokens)
+
     out = {
         "metric": f"{name} decode latency p50 (batch {B}, prompt {prompt_len})",
         "value": round(float(np.percentile(lat, 50)), 2),
         "unit": "ms/token",
         "p90_ms": round(float(np.percentile(lat, 90)), 2),
         "chained_ms_per_token": round(chained_ms, 2),
+        "fused_generate_ms_per_token": round(fused_ms, 2),
         "prefill_ms": round(prefill_ms, 2),
         "decode_attn": args.decode_attn,
         "platform": jax.default_backend(),
-        "tokens_per_sec": round(1000.0 / chained_ms * B, 1),
+        "tokens_per_sec": round(1000.0 / fused_ms * B, 1),
     }
     print(json.dumps(out), flush=True)
 
