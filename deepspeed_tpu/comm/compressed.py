@@ -33,8 +33,19 @@ def compressed_allreduce_p(tensor: jax.Array, error: jax.Array, axes: Axes):
     comp = tensor + error
     scale = jnp.sum(jnp.abs(comp)) / comp.size
     sign = jnp.sign(comp).astype(jnp.bfloat16)  # the 1-bit wire format
-    avg = lax.pmean(scale * sign.astype(jnp.float32), axes)
-    new_error = comp - scale * jnp.sign(comp)
+    # Wire format is the reference's own algorithm shape: each rank ships
+    # its COMPRESSED payload (bf16 sign*scale — the narrow dtype is where
+    # the bandwidth win lives) via all-gather, and every rank decompresses
+    # and averages locally in fp32 (nccl.py gathers sign bits + scales and
+    # averages server-side in fp32 too). A bf16 pmean would be fewer bytes
+    # still but accumulates in bf16 — the reduction rounding is uncompensated
+    # by error feedback and biases the 1-bit momentum.
+    payload = (scale * sign).astype(jnp.bfloat16)
+    gathered = lax.all_gather(payload, axes)  # [world, ...] bf16 on the wire
+    avg = jnp.mean(gathered.astype(jnp.float32), axis=0)
+    # error feedback compensates the payload as TRANSMITTED (bf16-rounded),
+    # not the fp32 product — otherwise the rounding residual leaks every step
+    new_error = comp - payload.astype(jnp.float32)
     return avg, new_error
 
 

@@ -112,6 +112,14 @@ class TransformerConfig:
     pld_enabled: bool = False
     pld_theta: float = 0.5
     pld_gamma: float = 0.001
+    # ZeRO-Infinity parameter tier (engine offload_param, see
+    # runtime/zero/param_offload.py): parameters live in pinned HOST memory
+    # and each scanned layer streams its slice into HBM just-in-time;
+    # gradients are pinned straight back to host. HBM then holds activations
+    # plus one layer's working set — models whose parameters exceed device
+    # memory train on one chip (reference: 13B on one 16 GB V100,
+    # partition_parameters.py:537 remote_device='cpu').
+    param_offload: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -299,6 +307,77 @@ def xla_attention(q, k, v, *, causal_offset=0, bias=None, causal=True, dtype=jnp
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _param_streamer(cfg: TransformerConfig):
+    """Per-layer host→device streaming hook for the scan bodies (identity
+    when param_offload is off). See runtime/zero/param_offload.py."""
+    if not cfg.param_offload:
+        return lambda t: t
+    from ..runtime.zero.param_offload import stream_to_device
+
+    return stream_to_device
+
+
+# Per-layer host slicing is worth it (and DMA-legal) only for the big matmul
+# stacks; leaves below this slice size are streamed whole at entry instead —
+# the role the reference's param_persistence_threshold plays
+# (stage3.py: small params stay resident), and it also keeps XLA's async
+# host dynamic-slice emitter away from sub-sublane slices it cannot tile.
+_PER_LAYER_STREAM_MIN_BYTES = 1 << 18
+
+
+def _per_layer_streamable(stacked) -> bool:
+    if getattr(stacked, "ndim", 0) < 3:
+        return False
+    import numpy as _np
+
+    elems = int(_np.prod(stacked.shape[1:]))
+    return elems * stacked.dtype.itemsize >= _PER_LAYER_STREAM_MIN_BYTES
+
+
+def _make_stack_loader(cfg: TransformerConfig, tree):
+    """(xs, load) for a stacked parameter tree under param_offload.
+
+    Big matmul stacks stay host-resident in ``xs``; ``load`` streams their
+    slices inside the scan body. Small stacks are streamed WHOLE at entry
+    (device-resident in ``xs``) and ``load`` passes them through untouched —
+    re-streaming an already-device slice would pin its tiny per-layer
+    cotangent to host inside the loop, which XLA's async host-DMA emitter
+    cannot tile (sub-sublane slices) and the per-slice transfers would be
+    wasteful anyway. Identity when param_offload is off."""
+    if not cfg.param_offload:
+        return tree, lambda t: t
+    from ..runtime.zero.param_offload import stream_to_device
+
+    big = jax.tree.map(_per_layer_streamable, tree)
+    xs = jax.tree.map(lambda v, b: v if b else stream_to_device(v), tree, big)
+
+    def load(sliced):
+        if isinstance(sliced, dict):
+            extras = {k: v for k, v in sliced.items() if k.startswith("_")}
+            core = {k: v for k, v in sliced.items() if not k.startswith("_")}
+            core = jax.tree.map(
+                lambda v, b: stream_to_device(v) if b else v, core, big)
+            return {**core, **extras}
+        return jax.tree.map(lambda v, b: stream_to_device(v) if b else v, sliced, big)
+
+    return xs, load
+
+
+def _stream_top_level(cfg: TransformerConfig, params: Params) -> Params:
+    """Stream the non-stacked leaves (embeddings, final LN, head) to device
+    once at entry; ``layers``/``moe`` stacks stay host-resident for the scan
+    bodies to stream slice-by-slice. No-op when param_offload is off."""
+    if not cfg.param_offload:
+        return params
+    from ..runtime.zero.param_offload import stream_to_device
+
+    out = dict(params)
+    for k, v in params.items():
+        if k not in ("layers", "moe"):
+            out[k] = stream_to_device(v)
+    return out
+
+
 _SAVED_NAMES = {"save_flash": ("flash_out", "flash_lse"), "nothing_saveable": ()}
 
 
@@ -373,13 +452,22 @@ def _attention_dispatch(cfg: TransformerConfig):
 
         bq = cfg.flash_block_q or None
         bk = cfg.flash_block_k or None
-        # additive bias (alibi/local windows) is not fused — those layers
-        # take the XLA path
-        return lambda q, k, v, bias: (
-            flash_attention(q, k, v, causal=cfg.causal, block_q=bq, block_k=bk)
-            if bias is None
-            else xla_attention(q, k, v, bias=bias, causal=cfg.causal)
-        )
+        slopes = alibi_slopes(cfg.num_heads) if cfg.pos_emb == "alibi" else None
+
+        def flash_fn(q, k, v, bias, window=None):
+            if bias is not None:
+                # general dense bias (not expressible as alibi/window)
+                return xla_attention(q, k, v, bias=bias, causal=cfg.causal)
+            return flash_attention(
+                q, k, v, causal=cfg.causal, block_q=bq, block_k=bk,
+                alibi_slopes=slopes, window=window,
+            )
+
+        # alibi and local windows are fused IN-KERNEL (computed from block
+        # positions; no [S,S] bias tensor) — the layer body passes the raw
+        # window instead of materializing a dense bias
+        flash_fn.handles_fused_bias = True
+        return flash_fn
     if cfg.attn_impl == "ring":
         from ..parallel.ring_attention import ring_attention_sharded
 
@@ -538,6 +626,17 @@ def _local_attn_bias(cfg: TransformerConfig, S: int):
 NEG_BIAS = -1e30
 
 
+def _attn_call(cfg, attn_fn, q, k, v, bias, is_local):
+    """Invoke attention with the layer's locality: fused dispatches get the
+    raw runtime window (0 = global); others get the dense-bias merge the
+    caller prepared in ``bias``."""
+    if getattr(attn_fn, "handles_fused_bias", False) and is_local is not None:
+        w = jnp.where(is_local.astype(bool),
+                      jnp.float32(cfg.local_attn_window), jnp.float32(0))
+        return attn_fn(q, k, v, bias, window=w)
+    return attn_fn(q, k, v, bias)
+
+
 def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, positions,
                 local_bias=None):
     lp = dict(lp)
@@ -557,12 +656,13 @@ def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, position
     if is_local is not None and local_bias is not None:
         lb = jnp.where(is_local.astype(bool), local_bias, 0.0)[None, None]
         bias = lb if bias is None else bias + lb
+    attn = lambda q, k, v: _attn_call(cfg, attn_fn, q, k, v, bias, is_local)
     x = carry  # [B, S, d] compute dtype
 
     if cfg.norm_style == "post":
         # BERT layout: sublayer -> residual add -> LayerNorm
         q, k, v = _qkv_proj(cfg, lp, x, positions)
-        attn_out = _attn_out_proj(cfg, lp, attn_fn(q, k, v, bias))
+        attn_out = _attn_out_proj(cfg, lp, attn(q, k, v))
         attn_out = gate * _dropout(attn_out, cfg.attn_dropout, k_attn)
         x = layer_norm(x + attn_out, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
         f = gate * _dropout(_ffn(cfg, lp, x), cfg.hidden_dropout, k_hidden)
@@ -571,7 +671,7 @@ def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, position
 
     h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
     q, k, v = _qkv_proj(cfg, lp, h, positions)
-    attn_out = _attn_out_proj(cfg, lp, attn_fn(q, k, v, bias))
+    attn_out = _attn_out_proj(cfg, lp, attn(q, k, v))
     attn_out = gate * _dropout(attn_out, cfg.attn_dropout, k_attn)
 
     if cfg.parallel_residual:
@@ -615,30 +715,44 @@ def apply(
     with_aux: bool = False,
     rng: Optional[jax.Array] = None,
     step=None,
+    _top_streamed: bool = False,
 ) -> jnp.ndarray:
     """tokens [B, S] int32 -> logits [B, S, vocab] (fp32), or the final hidden
     states [B, S, d] when ``return_hidden`` (used by the chunked LM loss).
     With ``with_aux`` returns (out, aux_loss) — MoE load-balancing loss.
     ``rng`` enables dropout / progressive layer drop (training); ``step``
-    drives the PLD theta schedule."""
+    drives the PLD theta schedule. ``_top_streamed``: the caller already
+    streamed the top-level leaves (param_offload) — a shared leaf (tied wte)
+    must be streamed exactly ONCE per differentiated function, or its two
+    host-pinned cotangents meet in an ``add`` XLA's host-offload legalizer
+    rejects."""
     B, S = tokens.shape
     L = cfg.num_layers
+    if not _top_streamed:
+        params = _stream_top_level(cfg, params)
     x, positions = embed(cfg, params, tokens, positions)
     if rng is not None:
         rng, k_emb = jax.random.split(rng)
         x = _dropout(x, cfg.hidden_dropout, k_emb)
-    bias = attn_bias(cfg, S)
     attn_fn = _attention_dispatch(cfg)
+    fused_bias = getattr(attn_fn, "handles_fused_bias", False)
+    # fused dispatches compute alibi/window from positions in-kernel — no
+    # [S,S] bias tensor is ever materialized
+    bias = None if fused_bias else attn_bias(cfg, S)
+    has_local = cfg.local_attn_window > 0 and cfg.local_attn_layers is not None
     local_bias = None
-    if cfg.local_attn_window > 0 and cfg.local_attn_layers is not None:
+    if has_local and not fused_bias:
         local_bias = _local_attn_bias(cfg, S)
     body = partial(
         _layer_body, cfg, attn_fn, alibi_bias=bias, positions=positions,
         local_bias=local_bias,
     )
 
-    layers_xs = params["layers"]
-    if local_bias is not None:
+    layers_xs, load_layer = _make_stack_loader(cfg, params["layers"])
+    moe_xs, load_moe = (None, lambda t: t)
+    if "moe" in params:
+        moe_xs, load_moe = _make_stack_loader(cfg, params["moe"])
+    if has_local:
         layers_xs = dict(layers_xs, _local=jnp.asarray(cfg.local_attn_layers, jnp.int32))
     needs_rng = cfg.hidden_dropout > 0 or cfg.attn_dropout > 0 or cfg.pld_enabled
     if rng is not None and needs_rng:
@@ -652,10 +766,10 @@ def apply(
     tag = _boundary_tagger(cfg)
 
     def scan_body(carry, lp):
-        return body(carry, lp)
+        return body(carry, load_layer(lp))
 
     def tagged_body(carry, lp):
-        return body(tag(carry), lp)
+        return body(tag(carry), load_layer(lp))
 
     policy = _remat_policy(cfg.remat_policy, offload=cfg.remat_offload) if cfg.remat else None
 
@@ -677,18 +791,19 @@ def apply(
             if E > 1:
                 dense_part = jax.tree.map(lambda a: a[: E - 1], lg)
                 x, _ = lax.scan(scan_body, x, dense_part)
-            lp_last = jax.tree.map(lambda a: a[E - 1], lg)
-            x, aux = _moe_layer(cfg, lp_last, moe_p, x, attn_fn, bias, positions, local_bias)
+            lp_last = load_layer(jax.tree.map(lambda a: a[E - 1], lg))
+            x, aux = _moe_layer(
+                cfg, lp_last, load_moe(moe_p), x, attn_fn, bias, positions, local_bias)
             return x, aux
 
-        x, auxs = lax.scan(maybe_remat(group_body), x, (layers_g, params["moe"]))
+        x, auxs = lax.scan(maybe_remat(group_body), x, (layers_g, moe_xs))
         aux_total = jnp.sum(auxs)
     elif E > 0:
         # non-uniform depth: python loop fallback
         for i in range(L):
-            lp = jax.tree.map(lambda a: a[i], layers_xs)
+            lp = load_layer(jax.tree.map(lambda a: a[i], layers_xs))
             if (i + 1) % E == 0 and "moe" in params:
-                moe_p = jax.tree.map(lambda a: a[(i + 1) // E - 1], params["moe"])
+                moe_p = load_moe(jax.tree.map(lambda a: a[(i + 1) // E - 1], moe_xs))
                 x, aux = _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions, local_bias)
                 aux_total = aux_total + aux
             else:
@@ -750,7 +865,9 @@ def _moe_layer(cfg, lp, moe_p, x, attn_fn, bias, positions, local_bias=None):
         bias = lb if bias is None else bias + lb
     h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
     q, k, v = _qkv_proj(cfg, lp, h, positions)
-    attn_out = gate * _dropout(_attn_out_proj(cfg, lp, attn_fn(q, k, v, bias)), cfg.attn_dropout, k_attn)
+    attn_out = gate * _dropout(
+        _attn_out_proj(cfg, lp, _attn_call(cfg, attn_fn, q, k, v, bias, is_local)),
+        cfg.attn_dropout, k_attn)
     x = x + attn_out
     h2 = layer_norm(x, lp["ln2_scale"], lp["ln2_bias"], cfg.layernorm_epsilon)
     moe_out, aux_loss = moe_ffn_apply(cfg, moe_p, h2, mesh=_ACTIVE_MESH[0])
@@ -808,6 +925,11 @@ def apply_with_cache(
             "silently change the attention pattern the model trained with"
         )
     B, T = tokens.shape
+    params = _stream_top_level(cfg, params)
+    layers_xs, load_layer = _make_stack_loader(cfg, params["layers"])
+    moe_xs, load_moe = (None, lambda t: t)
+    if "moe" in params:
+        moe_xs, load_moe = _make_stack_loader(cfg, params["moe"])
     positions = pos + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     x, _ = embed(cfg, params, tokens, positions)
 
@@ -851,7 +973,7 @@ def apply_with_cache(
         x = carry
         lp, k_cache, v_cache = inputs
         x, k_cache, v_cache = layer_core(
-            x, lp, k_cache, v_cache, lambda lp, h2: _ffn(cfg, lp, h2)
+            x, load_layer(lp), k_cache, v_cache, lambda lp, h2: _ffn(cfg, lp, h2)
         )
         return x, (k_cache, v_cache)
 
@@ -861,7 +983,7 @@ def apply_with_cache(
         E = cfg.moe_every
         G = cfg.num_layers // E
         regroup = lambda a: a.reshape((G, E) + a.shape[1:])
-        layers_g = jax.tree.map(regroup, params["layers"])
+        layers_g = jax.tree.map(regroup, layers_xs)
         kc_g, vc_g = regroup(cache["k"]), regroup(cache["v"])
         # decode (T=1): capacity-free routing — the capacity heuristic
         # degenerates to ~1 slot at single-token steps and drops colliding
@@ -877,10 +999,10 @@ def apply_with_cache(
             if E > 1:
                 firsts = jax.tree.map(lambda a: a[: E - 1], lg)
                 x, (kc_head, vc_head) = lax.scan(layer, x, (firsts, kc[: E - 1], vc[: E - 1]))
-            lp_last = jax.tree.map(lambda a: a[E - 1], lg)
+            lp_last = load_layer(jax.tree.map(lambda a: a[E - 1], lg))
             x, kc_last, vc_last = layer_core(
                 x, lp_last, kc[E - 1], vc[E - 1],
-                lambda lp, h2: moe_fn(moe_p, h2),
+                lambda lp, h2: moe_fn(load_moe(moe_p), h2),
             )
             if E > 1:
                 kc_new = jnp.concatenate([kc_head, kc_last[None]], axis=0)
@@ -890,12 +1012,12 @@ def apply_with_cache(
             return x, (kc_new, vc_new)
 
         x, (new_k_g, new_v_g) = lax.scan(
-            group_layer, x, (layers_g, params["moe"], kc_g, vc_g)
+            group_layer, x, (layers_g, moe_xs, kc_g, vc_g)
         )
         new_k = new_k_g.reshape((cfg.num_layers,) + new_k_g.shape[2:])
         new_v = new_v_g.reshape((cfg.num_layers,) + new_v_g.shape[2:])
     else:
-        x, (new_k, new_v) = lax.scan(layer, x, (params["layers"], cache["k"], cache["v"]))
+        x, (new_k, new_v) = lax.scan(layer, x, (layers_xs, cache["k"], cache["v"]))
     if last_only:
         x = x[:, -1:]
     if cfg.final_ln:
@@ -913,14 +1035,18 @@ def apply_with_cache(
 # Loss
 # ---------------------------------------------------------------------------
 
-def lm_loss_from_hidden(cfg: TransformerConfig, params: Params, hidden, labels) -> jnp.ndarray:
+def lm_loss_from_hidden(cfg: TransformerConfig, params: Params, hidden, labels,
+                        _top_streamed: bool = False) -> jnp.ndarray:
     """Token-mean next-token cross-entropy from final hidden states [B,S,d],
     with the vocab projection chunked over the sequence so [B,S,V] logits are
     never materialized (see ``causal_lm_loss``). Shared by the plain and
     pipelined model families."""
+    stream = (lambda t: t) if _top_streamed else _param_streamer(cfg)
     head = params.get("lm_head", None)
     if head is None:
-        head = params["wte"].T
+        head = stream(params["wte"]).T
+    else:
+        head = stream(head)
 
     chunk = cfg.loss_chunk_size
     S = hidden.shape[1]
@@ -974,10 +1100,15 @@ def causal_lm_loss(
     v5e this is what lets 125M-class models train at batch 64+.
     """
     inputs, labels = split_batch(batch)
+    # stream top-level leaves ONCE for both the embedding and the (tied)
+    # head use — see apply()'s _top_streamed note
+    params = _stream_top_level(cfg, params)
     hidden, aux = apply(
-        cfg, params, inputs, return_hidden=True, with_aux=True, rng=rng, step=step
+        cfg, params, inputs, return_hidden=True, with_aux=True, rng=rng, step=step,
+        _top_streamed=True,
     )  # [B, S, d]
-    return lm_loss_from_hidden(cfg, params, hidden, labels) + cfg.moe_aux_coeff * aux
+    return lm_loss_from_hidden(
+        cfg, params, hidden, labels, _top_streamed=True) + cfg.moe_aux_coeff * aux
 
 
 class Model:

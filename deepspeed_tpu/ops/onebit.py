@@ -64,26 +64,29 @@ def init_state(params: PyTree, dp: int) -> PyTree:
     }
 
 
-def momentum_sync(g_local, m, v, error_local, step, cfg: OneBitAdamConfig, dp_axes):
+def momentum_sync(g_local, m, v, error_local, cfg: OneBitAdamConfig, dp_axes,
+                  frozen: bool):
     """Per-device phase (inside shard_map): returns (m_new, v_new,
     error_new_local). ``g_local`` is this rank's UNREDUCED gradient;
     ``error_local`` has a leading [1] axis (the rank's shard).
 
-    step <= freeze_step: m/v from the pmean'd gradient (plain Adam moments) —
-                         compression begins at freeze_step + 1, matching the
-                         reference's boundary
-    step >  freeze_step: v frozen; m = pmean(scale * sign(m_local + error)),
-                         error updated with the compression residual.
+    frozen=False: m/v from the pmean'd gradient (plain Adam moments) —
+                  compression begins at freeze_step + 1, matching the
+                  reference's boundary
+    frozen=True:  v frozen; m = mean over ranks of the bf16-compressed
+                  payload, error updated with the compression residual.
 
-    The two phases are a ``lax.cond`` (the predicate is replicated, so every
-    device takes the same branch): the frozen stage really does skip the full
-    fp32 gradient pmean — a jnp.where formulation would execute BOTH
-    collectives every step and negate the compression.
+    ``frozen`` is a PYTHON bool — the engine compiles one program per phase
+    and switches host-side at freeze_step, exactly like the reference's
+    host-side step counter. (A traced ``lax.cond`` here put an all-reduce in
+    one branch and an all-gather in the other; XLA:CPU's thunk scheduler
+    races the two rendezvous at larger model sizes and deadlocks. Phase
+    specialization also guarantees — rather than hopes — that the frozen
+    program contains no full fp32 gradient all-reduce at all.)
     """
     b1, b2 = cfg.betas
 
-    def warm_fn(operands):
-        g_local, m, v, error_local = operands
+    if not frozen:
 
         def leaf(g, m, v, err):
             g_avg = lax.pmean(g, dp_axes)
@@ -93,10 +96,7 @@ def momentum_sync(g_local, m, v, error_local, step, cfg: OneBitAdamConfig, dp_ax
                 err,
             )
 
-        return _tree_leaf3(leaf, g_local, m, v, error_local)
-
-    def frozen_fn(operands):
-        g_local, m, v, error_local = operands
+    else:
 
         def leaf(g, m, v, err):
             from ..comm.compressed import compressed_allreduce_p
@@ -107,11 +107,7 @@ def momentum_sync(g_local, m, v, error_local, step, cfg: OneBitAdamConfig, dp_ax
             m_new, err_new = compressed_allreduce_p(m_loc, err[0], dp_axes)
             return m_new, v, err_new[None]
 
-        return _tree_leaf3(leaf, g_local, m, v, error_local)
-
-    return lax.cond(
-        step <= cfg.freeze_step, warm_fn, frozen_fn, (g_local, m, v, error_local)
-    )
+    return _tree_leaf3(leaf, g_local, m, v, error_local)
 
 
 def _tree_leaf3(leaf, g_local, m, v, error_local):

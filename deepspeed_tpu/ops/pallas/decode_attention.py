@@ -45,7 +45,7 @@ def _interpret_default() -> bool:
 
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                   *, sm_scale, block_k, num_kb):
+                   *, sm_scale, block_k, num_kb, slope_ref=None):
     # All-elementwise formulation: decode attention at T=1 is a matvec per
     # head — pure HBM streaming, so the MXU buys nothing and the VPU does the
     # whole block in consistent (kk, H, D)-shaped broadcasts/reductions.
@@ -72,6 +72,11 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         # s[kk, h] = sum_d q[h, d] * k[kk, h, d], kept as [Bk, H, 1]
         s3 = sm_scale * jnp.sum(k3 * q3, axis=2, keepdims=True)
         k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s3.shape, 0)
+        if slope_ref is not None:
+            # fused alibi (BLOOM): bias = slope_h * (k_pos - q_pos), computed
+            # from positions — the reference's softmax_context alibi path
+            # (pt_binding.cpp:1231-1283); q_pos == pos for the new token
+            s3 = s3 + slope_ref[...] * (k_pos - pos).astype(jnp.float32)
         s3 = jnp.where(k_pos <= pos, s3, NEG_INF)
         m_prev = m_scr[:, :, 0:1]                 # [1, H, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s3, axis=0, keepdims=True))
@@ -91,12 +96,14 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
 
 
 def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None, block_k: int = 512,
-                     interpret: bool | None = None):
+                     interpret: bool | None = None, alibi_slopes=None):
     """q [B, H, D], k/v_cache [B, Smax, H, D], pos [B] or scalar int32 (index
     of the newest valid cache entry) -> attention output [B, H, D].
 
     Equivalent to ``xla_attention(q[:, None], k_cache, v_cache,
     causal_offset=pos)[:, 0]`` but reads only the valid cache prefix.
+    ``alibi_slopes`` [H] fuses the BLOOM alibi bias in-kernel (computed from
+    cache positions, nothing streamed).
     """
     B, H, D = q.shape
     Smax = k_cache.shape[1]
@@ -122,14 +129,30 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None, block_k: int = 
     def clamp(j, p_ref, b):
         return jnp.minimum(j, p_ref[b] // block_k)
 
+    in_specs = [
+        pl.BlockSpec((1, H, D), lambda b, j, p: (b, 0, 0)),
+        pl.BlockSpec((1, block_k, H, D), lambda b, j, p: (b, clamp(j, p, b), 0, 0)),
+        pl.BlockSpec((1, block_k, H, D), lambda b, j, p: (b, clamp(j, p, b), 0, 0)),
+    ]
+    operands = [q, k_cache, v_cache]
+    base = functools.partial(
+        _decode_kernel, sm_scale=sm_scale, block_k=block_k, num_kb=num_kb
+    )
+    if alibi_slopes is None:
+        kernel = base
+    else:
+        slopes_arr = jnp.asarray(alibi_slopes, jnp.float32).reshape(1, H, 1)
+        in_specs.append(pl.BlockSpec((1, H, 1), lambda b, j, p: (0, 0, 0)))
+        operands.append(slopes_arr)
+
+        def kernel(pos_ref, q_ref, k_ref, v_ref, s_ref, o_ref, m_scr, l_scr, acc_scr):
+            return base(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                        acc_scr, slope_ref=s_ref)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, num_kb),
-        in_specs=[
-            pl.BlockSpec((1, H, D), lambda b, j, p: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, H, D), lambda b, j, p: (b, clamp(j, p, b), 0, 0)),
-            pl.BlockSpec((1, block_k, H, D), lambda b, j, p: (b, clamp(j, p, b), 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, D), lambda b, j, p: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((1, H, 1), jnp.float32),
@@ -137,13 +160,10 @@ def decode_attention(q, k_cache, v_cache, pos, *, sm_scale=None, block_k: int = 
             pltpu.VMEM((1, H, D), jnp.float32),
         ],
     )
-    kernel = functools.partial(
-        _decode_kernel, sm_scale=sm_scale, block_k=block_k, num_kb=num_kb
-    )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         interpret=interpret,
-    )(pos, q, k_cache, v_cache)
+    )(pos, *operands)
     return out

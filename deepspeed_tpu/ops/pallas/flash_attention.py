@@ -119,12 +119,69 @@ def _lanes(col, lanes=LANES):
 
 
 # ---------------------------------------------------------------------------
+# In-kernel scores (shared by forward + both backward kernels)
+# ---------------------------------------------------------------------------
+
+def _block_scores(q, k_blk, qi, kj, *, sm_scale, causal, slope_ref, w_ref):
+    """[Bq, Bk] fp32 scores with alibi / local-window / causal fused.
+
+    ``slope_ref`` (or None): [1, LANES] block of the per-program alibi slope
+    (one row per fused batch×head program) — the bias is COMPUTED from block
+    positions, never streamed from HBM (the reference threads alibi through
+    softmax_context_* the same way, pt_binding.cpp:1231-1283). ``w_ref`` (or
+    None): [1, LANES] runtime local-attention window; w <= 0 means global
+    (lets the scanned GPT-Neo layers alternate locality with one compiled
+    kernel)."""
+    block_q, block_k = q.shape[0], k_blk.shape[0]
+    s = sm_scale * jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [Bq, Bk] fp32 accumulator
+    need_pos = causal or slope_ref is not None or w_ref is not None
+    if need_pos:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+    if slope_ref is not None:
+        s = s + slope_ref[0, 0] * (k_pos - q_pos).astype(jnp.float32)
+    if w_ref is not None:
+        w = w_ref[0, 0]  # fp32 runtime window; w <= 0 means global
+        s = jnp.where((w <= 0) | ((q_pos - k_pos).astype(jnp.float32) < w), s, NEG_INF)
+    if causal:
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return s
+
+
+def _wrap_extras(base, n_in, has_slopes, has_window):
+    """Adapt a kernel so the optional slope/window operands (appended after
+    the regular inputs, in that order) reach it as keyword refs."""
+    if not has_slopes and not has_window:
+        return base
+
+    def wrapped(*refs):
+        ins = list(refs[:n_in])
+        i = n_in
+        kw = {}
+        if has_slopes:
+            kw["slope_ref"] = refs[i]
+            i += 1
+        if has_window:
+            kw["w_ref"] = refs[i]
+            i += 1
+        return base(*ins, *refs[i:], **kw)
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, sm_scale, causal, num_k,
+    *, sm_scale, causal, num_k, slope_ref=None, w_ref=None,
 ):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -141,17 +198,8 @@ def _fwd_kernel(
         q = q_ref[0]          # [Bq, D] native dtype — MXU runs at full rate in bf16
         k_blk = k_ref[0]      # [Bk, D]
         v_blk = v_ref[0]
-        s = sm_scale * jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [Bq, Bk] fp32 accumulator
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _block_scores(q, k_blk, qi, kj, sm_scale=sm_scale, causal=causal,
+                          slope_ref=slope_ref, w_ref=w_ref)
         m_prev = m_scr[...]                     # [Bq, LANES] lane-broadcast
         m_new = jnp.maximum(m_prev, _lanes(jnp.max(s, axis=1)))
         p = jnp.exp(s - _widen(m_new, block_k))
@@ -177,14 +225,28 @@ def _fwd_kernel(
         lse_ref[0] = m_scr[...] + jnp.log(l_safe)
 
 
-def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_forward(q, k, v, slopes_bh, w_arr, sm_scale, causal, block_q,
+                   block_k, interpret):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     num_k = Sk // block_k
     grid = (BH, Sq // block_q, num_k)
-    kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, num_k=num_k
+    base = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, num_k=num_k,
     )
+    in_specs = [
+        _vmem_spec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
+        _vmem_spec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+        _vmem_spec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
+    ]
+    operands = [q, k, v]
+    if slopes_bh is not None:
+        in_specs.append(_vmem_spec((1, LANES), lambda bh, qi, kj: (bh, 0)))
+        operands.append(slopes_bh)
+    if w_arr is not None:
+        in_specs.append(_vmem_spec((1, LANES), lambda bh, qi, kj: (0, 0)))
+        operands.append(w_arr)
+    kernel = _wrap_extras(base, 3, slopes_bh is not None, w_arr is not None)
     kwargs = {}
     cp = _compiler_params(len(grid))
     if cp is not None and not interpret:
@@ -192,11 +254,7 @@ def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            _vmem_spec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
-            _vmem_spec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
-            _vmem_spec((1, block_k, D), lambda bh, qi, kj: (bh, kj, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             _vmem_spec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
             _vmem_spec((1, block_q, LANES), lambda bh, qi, kj: (bh, qi, 0)),
@@ -212,7 +270,7 @@ def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         ],
         interpret=interpret,
         **kwargs,
-    )(q, k, v)
+    )(*operands)
     return out, lse
 
 
@@ -223,7 +281,7 @@ def _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 def _bwd_dkdv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, sm_scale, causal, num_q,
+    dk_scr, dv_scr, *, sm_scale, causal, num_q, slope_ref=None, w_ref=None,
 ):
     kj = pl.program_id(1)
     qi = pl.program_id(2)
@@ -243,17 +301,8 @@ def _bwd_dkdv_kernel(
         lse = lse_ref[0]      # [Bq, LANES]
         delta = delta_ref[0]  # [Bq, LANES]
 
-        s = sm_scale * jax.lax.dot_general(
-            q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [Bq, Bk]
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _block_scores(q_blk, k_blk, qi, kj, sm_scale=sm_scale, causal=causal,
+                          slope_ref=slope_ref, w_ref=w_ref)
         p = jnp.exp(s - _widen(lse, block_k))  # [Bq, Bk]
         # dV += P^T dO
         dv_scr[...] += jax.lax.dot_general(
@@ -285,7 +334,7 @@ def _bwd_dkdv_kernel(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, sm_scale, causal, num_k,
+    *, sm_scale, causal, num_k, slope_ref=None, w_ref=None,
 ):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -303,17 +352,8 @@ def _bwd_dq_kernel(
         delta = delta_ref[0]  # [Bq, LANES]
         k_blk = k_ref[0]
         v_blk = v_ref[0]
-        s = sm_scale * jax.lax.dot_general(
-            q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _block_scores(q_blk, k_blk, qi, kj, sm_scale=sm_scale, causal=causal,
+                          slope_ref=slope_ref, w_ref=w_ref)
         p = jnp.exp(s - _widen(lse, block_k))
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -335,7 +375,7 @@ def _bwd_dq_kernel(
 
 
 def _flash_backward(res, g, sm_scale, causal, block_q, block_k, interpret):
-    q, k, v, out, lse = res
+    q, k, v, slopes_bh, w_arr, out, lse = res
     lse = jnp.broadcast_to(lse[..., None], lse.shape + (LANES,))  # re-tile lanes
     BH, Sq, D = q.shape
     Sk = k.shape[1]
@@ -349,10 +389,23 @@ def _flash_backward(res, g, sm_scale, causal, block_q, block_k, interpret):
     if cp is not None and not interpret:
         kwargs["compiler_params"] = cp
 
+    has_slopes = slopes_bh is not None
+    has_window = w_arr is not None
+    extra_specs = []
+    extra_ops = []
+    if has_slopes:
+        extra_specs.append(_vmem_spec((1, LANES), lambda bh, a, b: (bh, 0)))
+        extra_ops.append(slopes_bh)
+    if has_window:
+        extra_specs.append(_vmem_spec((1, LANES), lambda bh, a, b: (0, 0)))
+        extra_ops.append(w_arr)
+
+    base_dkdv = functools.partial(
+        _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, num_q=num_q,
+    )
+    kern_dkdv = _wrap_extras(base_dkdv, 6, has_slopes, has_window)
     dkdv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkdv_kernel, sm_scale=sm_scale, causal=causal, num_q=num_q
-        ),
+        kern_dkdv,
         grid=(BH, num_k, num_q),
         in_specs=[
             _vmem_spec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
@@ -361,7 +414,7 @@ def _flash_backward(res, g, sm_scale, causal, block_q, block_k, interpret):
             _vmem_spec((1, block_q, D), lambda bh, kj, qi: (bh, qi, 0)),
             _vmem_spec((1, block_q, LANES), lambda bh, kj, qi: (bh, qi, 0)),
             _vmem_spec((1, block_q, LANES), lambda bh, kj, qi: (bh, qi, 0)),
-        ],
+        ] + extra_specs,
         out_specs=[
             _vmem_spec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
             _vmem_spec((1, block_k, D), lambda bh, kj, qi: (bh, kj, 0)),
@@ -373,13 +426,15 @@ def _flash_backward(res, g, sm_scale, causal, block_q, block_k, interpret):
         scratch_shapes=[_scratch((block_k, D)), _scratch((block_k, D))],
         interpret=interpret,
         **kwargs,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse, delta, *extra_ops)
     dk, dv = dkdv
 
+    base_dq = functools.partial(
+        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, num_k=num_k,
+    )
+    kern_dq = _wrap_extras(base_dq, 6, has_slopes, has_window)
     dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, num_k=num_k
-        ),
+        kern_dq,
         grid=(BH, num_q, num_k),
         in_specs=[
             _vmem_spec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
@@ -388,28 +443,34 @@ def _flash_backward(res, g, sm_scale, causal, block_q, block_k, interpret):
             _vmem_spec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
             _vmem_spec((1, block_q, LANES), lambda bh, qi, kj: (bh, qi, 0)),
             _vmem_spec((1, block_q, LANES), lambda bh, qi, kj: (bh, qi, 0)),
-        ],
+        ] + extra_specs,
         out_specs=_vmem_spec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
         scratch_shapes=[_scratch((block_q, D))],
         interpret=interpret,
         **kwargs,
-    )(q, k, v, g, lse, delta)
-    return dq, dk, dv
+    )(q, k, v, g, lse, delta, *extra_ops)
+    dslopes = jnp.zeros_like(slopes_bh) if has_slopes else None
+    dw = jnp.zeros_like(w_arr) if has_window else None
+    return dq, dk, dv, dslopes, dw
 
 
 # ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bhsd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_bhsd(q, k, v, slopes_bh, w_arr, sm_scale, causal, block_q, block_k,
+                interpret):
+    out, _ = _flash_forward(q, k, v, slopes_bh, w_arr, sm_scale, causal,
+                            block_q, block_k, interpret)
     return out
 
 
-def _flash_bhsd_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+def _flash_bhsd_fwd(q, k, v, slopes_bh, w_arr, sm_scale, causal, block_q,
+                    block_k, interpret):
+    out, lse = _flash_forward(q, k, v, slopes_bh, w_arr, sm_scale, causal,
+                              block_q, block_k, interpret)
     # Under jax.checkpoint, out/lse are the residuals the backward kernels
     # need; naming them lets a remat policy (models/transformer.py
     # _remat_policy 'flash' names) save them so the forward kernel is NOT
@@ -417,7 +478,7 @@ def _flash_bhsd_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     # the lane-tiled [BH,S,LANES]) so the saved residual is 128x smaller.
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse[:, :, 0], "flash_lse")
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, slopes_bh, w_arr, out, lse)
 
 
 def _flash_bhsd_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
@@ -437,11 +498,20 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
+    alibi_slopes=None,
+    window=None,
 ):
     """Fused blockwise attention. q/k/v: [B, S, H, D] -> [B, S, H, D].
 
-    ``bias`` (e.g. alibi) is not fused; callers needing additive bias use the
-    XLA path (models/transformer._attention_dispatch falls back).
+    Structured biases are FUSED (computed from block positions in-kernel, no
+    HBM bias tensor — the reference threads alibi through its inference
+    kernels the same way, pt_binding.cpp:1231-1283):
+      * ``alibi_slopes``: per-head slopes [H] (BLOOM). Bias added to the
+        scores is slope_h * (k_pos - q_pos).
+      * ``window``: runtime local-attention window (traced scalar; <= 0 means
+        global) — GPT-Neo's alternating local layers run one compiled kernel.
+    A general dense ``bias`` tensor is not fused; those callers use the XLA
+    path (models/transformer._attention_dispatch falls back).
 
     Sequence lengths need not be block-aligned when ``causal``: q/k/v are
     zero-padded up to a 128 multiple — padded key positions sit *after* every
@@ -450,11 +520,22 @@ def flash_attention(
     train fine under attn_impl='flash').
     """
     if bias is not None:
-        raise NotImplementedError("flash_attention: additive bias not fused; use attn_impl='xla'")
+        raise NotImplementedError("flash_attention: dense additive bias not fused; use attn_impl='xla'")
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(D)
+    slopes_bh = None
+    if alibi_slopes is not None:
+        sl = jnp.asarray(alibi_slopes, jnp.float32)
+        assert sl.shape == (H,), (sl.shape, H)
+        # one [LANES] row per fused batch×head program
+        slopes_bh = jnp.broadcast_to(
+            jnp.tile(sl, B)[:, None], (B * H, LANES))
+    w_arr = None
+    if window is not None:
+        w_arr = jnp.full((1, LANES), 0.0, jnp.float32) + jnp.asarray(
+            window, jnp.float32)
 
     pad_q = (-Sq) % 128
     pad_k = (-Sk) % 128
@@ -485,7 +566,8 @@ def flash_attention(
         return x.transpose(0, 2, 1, 3).reshape(x.shape[0] * x.shape[2], x.shape[1], x.shape[3])
 
     out = _flash_bhsd(
-        to_bhsd(q), to_bhsd(k), to_bhsd(v), sm_scale, causal, block_q, block_k, interpret
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), slopes_bh, w_arr, sm_scale, causal,
+        block_q, block_k, interpret
     )
     out = out.reshape(B, H, Sq_p, D).transpose(0, 2, 1, 3)
     if pad_q:

@@ -66,6 +66,10 @@ class SparseSelfAttention:
             return sparse_flash_attention(
                 q, k, v, self.layout(S), causal=self.causal,
                 sm_scale=self.softmax_scale)
+        if self.softmax_scale is not None:
+            # the dense fallback (xla_attention) hard-codes 1/sqrt(D); fold
+            # the configured scale into q so both paths see identical logits
+            q = q * (self.softmax_scale * float(np.sqrt(D)))
         bias = jnp.asarray(self._dense_mask(S))[None]  # [1, H|1, S, S]
         if attn_mask is not None:
             am = jnp.asarray(attn_mask, jnp.float32)

@@ -39,13 +39,18 @@ from ..runtime.engine import DeepSpeedEngine
 from ..utils.logging import log_dist
 
 
-def pipeline_apply(stage_fn, stage_params, x_mb, num_stages: int, mesh: Optional[Mesh]):
+def pipeline_apply(stage_fn, stage_params, x_mb, num_stages: int, mesh: Optional[Mesh],
+                   collect_aux: bool = False):
     """Stream M microbatches through S stages; returns last-stage outputs.
 
-    stage_fn:     (per-stage params, h[mb, ...]) -> h[mb, ...]
+    stage_fn:     (per-stage params, h[mb, ...]) -> h[mb, ...], or with
+                  ``collect_aux`` -> (h, aux_scalar) (e.g. MoE load-balancing
+                  losses); aux is summed over VALID (stage, tick) pairs only —
+                  bubble/drain re-feeds contribute nothing.
     stage_params: pytree with leading axis [S, ...] (sharded over 'pipe')
     x_mb:         [M, mb, ...] stage-0 inputs (already embedded)
     returns:      [M, mb, ...] outputs of the last stage
+                  (with collect_aux: (outputs, aux_sum))
 
     Clock t of the scan computes, in parallel across pipe ranks, stage s's
     work on microbatch t - s (where valid) — the diagonal wavefront of the
@@ -81,12 +86,18 @@ def pipeline_apply(stage_fn, stage_params, x_mb, num_stages: int, mesh: Optional
     outs = jnp.zeros((M,) + mb_shape, dtype)
 
     def tick(carry, t):
-        buf, outs = carry
+        buf, outs, aux_sum = carry
         # stage 0 ingests microbatch t (dummy re-feed of the last mb during drain)
         x0 = lax.dynamic_index_in_dim(x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
         buf = buf.at[0].set(jnp.where(t < M, x0, buf[0]))
         buf = constrain_stage(buf)
-        y = jax.vmap(stage_fn)(stage_params, buf)  # all stages, one program
+        if collect_aux:
+            y, aux = jax.vmap(stage_fn)(stage_params, buf)  # aux [S]
+            stage_mb = t - jnp.arange(S)  # microbatch at each stage this tick
+            valid = (stage_mb >= 0) & (stage_mb < M)
+            aux_sum = aux_sum + jnp.sum(jnp.where(valid, aux, 0.0))
+        else:
+            y = jax.vmap(stage_fn)(stage_params, buf)  # all stages, one program
         y = constrain_stage(y)
         # collect last stage's result for microbatch t - (S-1)
         idx = t - (S - 1)
@@ -94,10 +105,12 @@ def pipeline_apply(stage_fn, stage_params, x_mb, num_stages: int, mesh: Optional
         outs = jnp.where(idx >= 0, upd, outs)
         # hand stage s's output to stage s+1  (CollectivePermute over 'pipe')
         buf = jnp.roll(y, 1, axis=0)
-        return (buf, outs), None
+        return (buf, outs, aux_sum), None
 
-    (_, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(M + S - 1))
-    return constrain_mb(outs)
+    (_, outs, aux_sum), _ = lax.scan(
+        tick, (buf, outs, jnp.zeros((), jnp.float32)), jnp.arange(M + S - 1))
+    outs = constrain_mb(outs)
+    return (outs, aux_sum) if collect_aux else outs
 
 
 def pipeline_train_1f1b(
@@ -281,6 +294,12 @@ class PipelineEngine(DeepSpeedEngine):
         )
         if self._pipe_schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"pipeline.schedule must be gpipe|1f1b, got {self._pipe_schedule}")
+        if (self._pipe_schedule == "1f1b"
+                and getattr(getattr(model, "config", None), "moe_every", 0) > 0):
+            raise NotImplementedError(
+                "MoE under the executed 1F1B schedule is not wired up (the "
+                "clocked program has no aux-loss channel); use "
+                "pipeline.schedule='gpipe' for PPxEP")
         super().__init__(model=model, config=config, **kwargs)
         # Config gas IS the microbatch count (reference pipe/engine.py:83).
         # A model left at the default adopts it; an explicit conflicting value

@@ -221,7 +221,6 @@ class PipelinedTransformer(Model):
         assert cfg.num_layers % num_stages == 0, (
             f"num_layers={cfg.num_layers} must divide evenly into {num_stages} stages"
         )
-        assert cfg.moe_every == 0, "MoE+PP composition is not supported yet"
         if cfg.hidden_dropout > 0 or cfg.attn_dropout > 0 or cfg.pld_enabled:
             raise NotImplementedError(
                 "dropout/progressive-layer-drop under pipeline parallelism is "
@@ -231,6 +230,14 @@ class PipelinedTransformer(Model):
         self.num_stages = num_stages
         self.num_micro_batches = num_micro_batches
         self.layers_per_stage = cfg.num_layers // num_stages
+        # MoE under PP (PP x EP composition — reference topology claims
+        # runtime/pipe/topology.py:243): every stage must hold a whole number
+        # of (moe_every)-layer groups so the expert stacks split evenly into
+        # a [S, n_moe/S, ...] stage axis.
+        if cfg.moe_every > 0 and self.layers_per_stage % cfg.moe_every != 0:
+            raise ValueError(
+                f"MoE+PP needs layers_per_stage ({self.layers_per_stage}) "
+                f"divisible by moe_every ({cfg.moe_every})")
 
     # -- params: reshape [L, ...] -> [S, L/S, ...] --------------------------
     def init(self, rng):
@@ -239,6 +246,10 @@ class PipelinedTransformer(Model):
         flat["layers"] = jax.tree.map(
             lambda a: a.reshape((S, K) + a.shape[1:]), flat["layers"]
         )
+        if "moe" in flat:
+            flat["moe"] = jax.tree.map(
+                lambda a: a.reshape((S, a.shape[0] // S) + a.shape[1:]), flat["moe"]
+            )
         return flat
 
     def logical_axes(self):
@@ -248,6 +259,12 @@ class PipelinedTransformer(Model):
             axes["layers"],
             is_leaf=lambda x: isinstance(x, tuple),
         )
+        if "moe" in axes:
+            axes["moe"] = jax.tree.map(
+                lambda ax: ("stage",) + ax,
+                axes["moe"],
+                is_leaf=lambda x: isinstance(x, tuple),
+            )
         return axes
 
     # -- compiled pipeline loss --------------------------------------------
@@ -263,22 +280,61 @@ class PipelinedTransformer(Model):
         positions = full_positions[: B // M]  # identical rows; per-microbatch view
         bias = tfm.attn_bias(cfg, Sq)
         attn_fn = tfm._attention_dispatch(cfg)
+        E = cfg.moe_every
+        has_moe = E > 0 and "moe" in params
+        K = self.layers_per_stage
 
-        def stage_fn(stage_params, h):
-            body = partial(
-                tfm._layer_body, cfg, attn_fn, alibi_bias=bias, positions=positions
+        body = partial(
+            tfm._layer_body, cfg, attn_fn, alibi_bias=bias, positions=positions
+        )
+        if cfg.remat:
+            body = jax.checkpoint(
+                body, policy=tfm._remat_policy(cfg.remat_policy), prevent_cse=False
             )
-            if cfg.remat:
-                body = jax.checkpoint(
-                    body, policy=tfm._remat_policy(cfg.remat_policy), prevent_cse=False
-                )
-            h, _ = lax.scan(lambda c, lp: body(c, lp), h, stage_params)
-            return h
 
-        x_mb = x.reshape((M, B // M) + x.shape[1:])  # [M, mb, Sq, d]
-        out_mb = pipeline_apply(stage_fn, params["layers"], x_mb, self.num_stages, self.mesh)
+        if has_moe:
+            # PP x EP: each stage scans its (E-1 dense + 1 MoE)-layer groups;
+            # the MoE aux (load-balancing) losses stream back through
+            # pipeline_apply's validity-gated side channel.
+            G = K // E
+
+            def stage_fn(stage_params, h):
+                lg_full, moe_p = stage_params
+                lg_g = jax.tree.map(
+                    lambda a: a.reshape((G, E) + a.shape[1:]), lg_full)
+
+                def group_body(c, xs):
+                    lgg, mp = xs
+                    if E > 1:
+                        dense = jax.tree.map(lambda a: a[: E - 1], lgg)
+                        c, _ = lax.scan(lambda cc, lp: body(cc, lp), c, dense)
+                    lp_last = jax.tree.map(lambda a: a[E - 1], lgg)
+                    c, aux = tfm._moe_layer(
+                        cfg, lp_last, mp, c, attn_fn, bias, positions)
+                    return c, aux
+
+                h, auxs = lax.scan(group_body, h, (lg_g, moe_p))
+                return h, jnp.sum(auxs)
+
+            stage_tree = (params["layers"], params["moe"])
+            out_mb, aux = pipeline_apply(
+                stage_fn, stage_tree, x_mb := x.reshape((M, B // M) + x.shape[1:]),
+                self.num_stages, self.mesh, collect_aux=True)
+        else:
+
+            def stage_fn(stage_params, h):
+                h, _ = lax.scan(lambda c, lp: body(c, lp), h, stage_params)
+                return h
+
+            x_mb = x.reshape((M, B // M) + x.shape[1:])  # [M, mb, Sq, d]
+            out_mb = pipeline_apply(
+                stage_fn, params["layers"], x_mb, self.num_stages, self.mesh)
+            aux = jnp.zeros((), jnp.float32)
         hidden = out_mb.reshape((B,) + out_mb.shape[2:])
         hidden = tfm.layer_norm(
             hidden, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon
         )
-        return tfm.lm_loss_from_hidden(cfg, params, hidden, labels)
+        nll = tfm.lm_loss_from_hidden(cfg, params, hidden, labels)
+        # aux accumulated once per microbatch per group: average over M to
+        # match the base model's per-batch group sum
+        return nll + cfg.moe_aux_coeff * aux / M

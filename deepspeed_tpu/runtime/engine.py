@@ -231,17 +231,51 @@ class DeepSpeedEngine:
             raise NotImplementedError(
                 "offload_optimizer device 'nvme' with fp16 dynamic loss "
                 "scaling is not supported; use bf16")
+        # ---- ZeRO-Infinity parameter tier (offload_param) -------------------
+        # Reference: partition_parameters.py:537 remote_device='cpu'|'nvme' +
+        # partitioned_param_swapper.py:38. TPU-native: the parameter pytree
+        # lives in pinned host memory and the model's layer scan streams one
+        # slice at a time into HBM (runtime/zero/param_offload.py); gradients
+        # are pinned straight back to host, and the optimizer update runs on
+        # the host tier (cpu: compute_on region; nvme: swapped groups).
         off_param = self.config.zero_optimization.offload_param
-        if off_param.device != "none":
-            raise NotImplementedError(
-                "offload_param is not supported: ZeRO-3 param sharding over the "
-                "fsdp axis covers the param-memory budget on TPU; set "
-                "offload_param.device='none'"
-            )
+        if off_param.device not in ("none", "cpu", "nvme"):
+            raise ValueError(
+                f"offload_param.device must be none|cpu|nvme, got {off_param.device!r}")
+        self.offload_param_enabled = off_param.device != "none"
+        if self.offload_param_enabled:
+            if not (self.offload_optimizer_enabled or self._nvme_offload):
+                raise ValueError(
+                    "offload_param requires offload_optimizer device 'cpu' or "
+                    "'nvme': with parameters tiered out of HBM, device-resident "
+                    "fp32 masters + Adam moments (6x the bf16 param bytes) "
+                    "would dwarf the savings")
+            if off_param.device == "nvme" and not self._nvme_offload:
+                raise ValueError(
+                    "offload_param device 'nvme' pairs with offload_optimizer "
+                    "device 'nvme' (fp32 masters+moments on disk; the bf16 "
+                    "working set stays in pinned host DRAM, which the device "
+                    "streams from — 2 bytes/param of DRAM instead of 16)")
+            mcfg = getattr(model, "config", None)
+            if mcfg is None or not hasattr(mcfg, "param_offload"):
+                raise NotImplementedError(
+                    "offload_param needs a model family with per-layer param "
+                    "streaming (models/transformer.py param_offload)")
+            if hasattr(model, "num_stages"):
+                raise NotImplementedError(
+                    "offload_param under pipeline parallelism is not wired up "
+                    "(the pipelined loss path does not stream params); use the "
+                    "plain model family or drop offload_param")
+            if not mcfg.param_offload:
+                model.config = mcfg.replace(param_offload=True)
         # memory-kind I/O through jit is TPU-only; on the CPU test backend the
         # same compute_on('device_host') path runs with device-memory state.
+        _on_tpu = jax.devices()[0].platform == "tpu"
         self._host_memory_kind = (
-            "pinned_host" if (self.offload_optimizer_enabled and jax.devices()[0].platform == "tpu") else None
+            "pinned_host" if (self.offload_optimizer_enabled and _on_tpu) else None
+        )
+        self._param_memory_kind = (
+            "pinned_host" if (self.offload_param_enabled and _on_tpu) else None
         )
 
         # ---- optimizer -------------------------------------------------------
@@ -259,9 +293,15 @@ class DeepSpeedEngine:
                     "onebitadam requires zero stage 0/1 (the reference has the "
                     "same restriction): momentum must be replicated to compress"
                 )
-            if self.offload_optimizer_enabled:
+            if self.offload_optimizer_enabled or self._nvme_offload:
                 raise NotImplementedError("onebitadam with offload_optimizer is unsupported")
+            if self.offload_param_enabled:
+                raise NotImplementedError(
+                    "onebitadam with offload_param is unsupported (replicated "
+                    "momenta live on device)")
             self._onebit_cfg = OneBitAdamConfig.from_params(opt_cfg.params)
+            self._onebit_applied_steps = 0
+            self._onebit_steps: dict[bool, Any] = {}
             mcfg = getattr(model, "config", None)
             if mcfg is not None and (
                 getattr(mcfg, "hidden_dropout", 0.0) > 0
@@ -291,6 +331,14 @@ class DeepSpeedEngine:
         # ---- state init (sharded at materialization — replaces zero.Init) ---
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         param_shardings = shd.tree_shardings(self.mesh, self.param_specs)
+        if self._param_memory_kind:
+            # the parameter tier's source of truth lives in pinned host
+            # memory; init computes on device and spills leaf-by-leaf
+            param_shardings = jax.tree.map(
+                lambda s: s.with_memory_kind(self._param_memory_kind),
+                param_shardings,
+                is_leaf=lambda x: isinstance(x, NamedSharding),
+            )
         if params is None:
             init_fn = jax.jit(model.init, out_shardings=param_shardings)
             params = init_fn(rng)
@@ -442,6 +490,7 @@ class DeepSpeedEngine:
             self.curriculum_scheduler = CurriculumScheduler(self.config.curriculum_learning)
 
         self._train_step = None  # compiled lazily (shape-dependent)
+        self._check_output_shardings = False
         self._grad_fn = None
         self._apply_fn = None
         self._accum_grads = None
@@ -595,38 +644,62 @@ class DeepSpeedEngine:
         hkind = self._host_memory_kind
         master_shardings = self._to_host_shardings(
             shd.tree_shardings(mesh, self.opt_specs_for_params))
-        param_shardings = shd.tree_shardings(mesh, param_specs)
+        # offload_param: the bf16 working copy STAYS in host memory (the
+        # state shardings carry the pinned_host kind) — copy-back targets
+        # host, and the device streams slices per layer next step
+        param_shardings = self._state_shardings["params"]
+
+        offp = self.offload_param_enabled
 
         def apply_update(state, grads, finite, step1, lr):
+            opt_in, master_in = state["opt"], state["master"]
             if hkind:
                 # the host region's operands must ALL be in host memory space
-                # (the TPU runtime rejects mixed-space elementwise ops; the CPU
-                # test backend is lax about it) — stage the d2h copies
-                # explicitly so XLA schedules them as the reference schedules
-                # its grad-copy stream (cpu_adam.cpp + custom_cuda_kernel.cu)
+                # (mixed-space elementwise ops are rejected) — stage the d2h
+                # copies explicitly so XLA schedules them as the reference
+                # schedules its grad-copy stream (cpu_adam.cpp +
+                # custom_cuda_kernel.cu)
                 grads = jax.tree.map(jax.device_put, grads, master_shardings)
                 host_scalar = NamedSharding(mesh, PartitionSpec(), memory_kind=hkind)
                 finite_h, step1_h, lr_h = (
                     jax.device_put(x, host_scalar) for x in (finite, step1, lr))
+            elif offp:
+                # CPU test backend under offload_param: the streaming vjp
+                # marks grads <host> in the type system even though the
+                # backend has one physical memory — align every operand's
+                # space abstractly
+                to_host = lambda t: jax.tree.map(
+                    lambda a: jax.device_put(a, jax.memory.Space.Host), t)
+                opt_in, master_in = to_host(opt_in), to_host(master_in)
+                finite_h, step1_h, lr_h = (
+                    jax.device_put(x, jax.memory.Space.Host)
+                    for x in (finite, step1, lr))
             else:
                 finite_h, step1_h, lr_h = finite, step1, lr
             new_master, new_opt, p16 = host_update(
-                grads, state["opt"], state["master"], finite_h, step1_h, lr_h
+                grads, opt_in, master_in, finite_h, step1_h, lr_h
             )
             if hkind:
-                # h2d copy-back of the bf16 working weights
+                # copy-back of the bf16 working weights (to HBM normally; to
+                # pinned host under offload_param)
                 p16 = jax.tree.map(jax.device_put, p16, param_shardings)
-            p16 = shd.constrain(p16, mesh, param_specs)
+            if not self.offload_param_enabled:
+                p16 = shd.constrain(p16, mesh, param_specs)
             return p16, new_opt, {"master": new_master}
 
         return apply_update
 
     # ------------------------------------------------------------------
-    def _build_onebit_train_step(self):
+    def _build_onebit_train_step(self, frozen: bool):
         """1-bit Adam train step: the grad + compress + momentum-sync phase
         runs per-device inside shard_map over (data, fsdp) — the local
         gradients a compressor needs are invisible under plain pjit — then
-        the replicated parameter update runs outside (ops/onebit.py)."""
+        the replicated parameter update runs outside (ops/onebit.py).
+
+        One program is compiled PER PHASE (``frozen``) and the engine
+        switches host-side at freeze_step (reference onebit/adam.py keeps
+        the same host-side step counter): the frozen executable provably
+        contains no fp32 gradient all-reduce."""
         from jax import shard_map
 
         from ..ops import onebit as ob
@@ -639,7 +712,8 @@ class DeepSpeedEngine:
         obc = self._onebit_cfg
         dp_axes = ("data", "fsdp")
         fp16 = cfg.fp16
-        if cfg.gradient_clipping > 0:
+        if cfg.gradient_clipping > 0 and not getattr(self, "_onebit_clip_warned", False):
+            self._onebit_clip_warned = True
             log_dist(
                 "onebitadam: gradient_clipping is not applied in the compressed "
                 "stage (the sign compression bounds update magnitude); warmup "
@@ -661,7 +735,7 @@ class DeepSpeedEngine:
             loss = model.loss(cast, mb)
             return loss * loss_scale, loss
 
-        def sharded_phase(params, m, v, error, batch, step1, loss_scale):
+        def sharded_phase(params, m, v, error, batch, loss_scale):
             def reshape_leaf(x):
                 return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
 
@@ -693,13 +767,13 @@ class DeepSpeedEngine:
                 dp_axes,
             )
             gnorm = jnp.sqrt(gsq)
-            m_new, v_new, err_new = ob.momentum_sync(g, m, v, error, step1, obc, dp_axes)
+            m_new, v_new, err_new = ob.momentum_sync(g, m, v, error, obc, dp_axes, frozen)
             return loss, finite, gnorm, m_new, v_new, err_new
 
         sm = shard_map(
             sharded_phase,
             mesh=mesh,
-            in_specs=(params_P, mv_P, mv_P, err_P, batch_P, P(), P()),
+            in_specs=(params_P, mv_P, mv_P, err_P, batch_P, P()),
             out_specs=(P(), P(), P(), mv_P, mv_P, err_P),
             check_vma=False,
         )
@@ -709,7 +783,7 @@ class DeepSpeedEngine:
             loss_scale = state["loss_scale"]
             loss, finite_i, gnorm, m_new, v_new, err_new = sm(
                 state["params"], state["opt"]["m"], state["opt"]["v"],
-                state["opt"]["error"], batch, step1, loss_scale,
+                state["opt"]["error"], batch, loss_scale,
             )
             finite = finite_i > 0
             lr = self.lr_schedule(step1)
@@ -791,9 +865,34 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # Fused train step
     # ------------------------------------------------------------------
+    def _onebit_step_fn(self):
+        """Phase-specialized compiled step for the CURRENT host-side applied
+        step count: warm (exact Adam, fp32 pmean) through freeze_step,
+        compressed after. One cached executable per phase."""
+        frozen = (self._onebit_applied_steps + 1) > self._onebit_cfg.freeze_step
+        fn = self._onebit_steps.get(frozen)
+        if fn is None:
+            fn = self._onebit_steps[frozen] = self._build_onebit_train_step(frozen)
+        return fn
+
+    def _train_batch_onebit_account(self, metrics):
+        """Advance the host-side mirror of the optimizer-step clock.
+
+        While still warm the overflow scalar is fetched so non-finite steps
+        (whose device-side state['step'] freezes) don't advance the phase
+        clock — the warm→frozen boundary lands exactly where the reference's
+        optimizer-step counter puts it. Once frozen the phase is monotone
+        (the clock only grows), so the per-step fetch is dropped and steps
+        chain asynchronously again — the fetch would decide nothing."""
+        if self._onebit_applied_steps > self._onebit_cfg.freeze_step:
+            self._onebit_applied_steps += 1  # phase can never flip back
+            return
+        if not bool(np.asarray(jax.device_get(metrics["overflow"]))):
+            self._onebit_applied_steps += 1
+
     def _build_train_step(self, grads_only: bool = False):
         if self._onebit_cfg is not None:
-            return self._build_onebit_train_step()
+            return self._build_onebit_train_step(frozen=False)
         cfg = self.config
         mesh = self.mesh
         gas = self.gradient_accumulation_steps
@@ -809,6 +908,34 @@ class DeepSpeedEngine:
 
         dropout = self._dropout_enabled
 
+        # offload_param: gradients come back PINNED TO HOST (the model's
+        # stream_to_device vjp) — every full-tree gradient op (accumulate,
+        # scale, finite-check, clip) must run as a host region, or XLA would
+        # round-trip the whole model through HBM and defeat the tier.
+        offp = self.offload_param_enabled
+        if offp:
+            from jax.experimental.compute_on import compute_on
+
+            grad_shardings = shd.tree_shardings(mesh, grad_specs)
+            if self._param_memory_kind:
+                grad_shardings = jax.tree.map(
+                    lambda s: s.with_memory_kind(self._param_memory_kind),
+                    grad_shardings,
+                    is_leaf=lambda x: isinstance(x, NamedSharding),
+                )
+            host_add = compute_on("device_host")(jax.jit(_tree_add))
+
+            def _finalize(grads, loss_scale):
+                grads = _tree_scale(grads, 1.0 / (loss_scale * gas))
+                finite = jnp.all(jnp.stack(
+                    [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]))
+                gnorm = _global_norm(grads)
+                if clip > 0:
+                    grads = _tree_scale(grads, jnp.minimum(1.0, clip / (gnorm + 1e-6)))
+                return grads, finite, gnorm
+
+            finalize_grads = compute_on("device_host")(jax.jit(_finalize))
+
         def train_step(state, batch):
             params = state["params"]
             loss_scale = state["loss_scale"]
@@ -822,37 +949,71 @@ class DeepSpeedEngine:
                 jax.random.fold_in(jax.random.PRNGKey(0), state["step"] + 1), gas
             )
 
-            zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            zero_grads = shd.constrain(zero_grads, mesh, grad_specs)
-
-            def micro(carry, mb_rng):
-                mb, rng = mb_rng
-                g_acc, l_acc = carry
-                mb = jax.tree.map(
+            def constrain_mb(mb):
+                return jax.tree.map(
                     lambda x: jax.lax.with_sharding_constraint(
                         x, NamedSharding(mesh, batch_spec)
                     ) if x.ndim >= 2 else x,
                     mb,
                 )
-                loss, grads = micro_grad(
-                    params, mb, loss_scale, rng if dropout else None, state["step"] + 1
+
+            if offp and gas == 1:
+                # no accumulator at all: the single micro-batch's host-pinned
+                # grads flow straight to finalize — HBM never sees the stack
+                mb = jax.tree.map(lambda x: x[0], batch_g)
+                loss_sum, grads = micro_grad(
+                    params, constrain_mb(mb), loss_scale,
+                    micro_rngs[0] if dropout else None, state["step"] + 1,
                 )
-                grads = shd.constrain(grads, mesh, grad_specs)
-                return (_tree_add(g_acc, grads), l_acc + loss), None
+            else:
+                if offp and self._param_memory_kind:
+                    zero_grads = jax.tree.map(
+                        lambda p, s: jax.device_put(
+                            jnp.zeros(p.shape, jnp.float32), s),
+                        params, grad_shardings)
+                elif offp:
+                    # CPU test backend: mark the accumulator <host> so the
+                    # host_add operands' spaces agree in the type system
+                    zero_grads = jax.tree.map(
+                        lambda p: jax.device_put(
+                            jnp.zeros(p.shape, jnp.float32), jax.memory.Space.Host),
+                        params)
+                else:
+                    zero_grads = jax.tree.map(
+                        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    zero_grads = shd.constrain(zero_grads, mesh, grad_specs)
 
-            (grads, loss_sum), _ = jax.lax.scan(
-                micro, (zero_grads, jnp.zeros((), jnp.float32)), (batch_g, micro_rngs)
-            )
+                def micro(carry, mb_rng):
+                    mb, rng = mb_rng
+                    g_acc, l_acc = carry
+                    mb = constrain_mb(mb)
+                    loss, grads = micro_grad(
+                        params, mb, loss_scale, rng if dropout else None, state["step"] + 1
+                    )
+                    if offp:
+                        g_acc = host_add(g_acc, grads)
+                    else:
+                        grads = shd.constrain(grads, mesh, grad_specs)
+                        g_acc = _tree_add(g_acc, grads)
+                    return (g_acc, l_acc + loss), None
+
+                (grads, loss_sum), _ = jax.lax.scan(
+                    micro, (zero_grads, jnp.zeros((), jnp.float32)), (batch_g, micro_rngs)
+                )
             loss = loss_sum / gas
-            inv = 1.0 / (loss_scale * gas)
-            grads = _tree_scale(grads, inv)
-
-            flat = jax.tree.leaves(grads)
-            finite = jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat]))
-            gnorm = _global_norm(grads)
-            if clip > 0:
-                scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                grads = _tree_scale(grads, scale)
+            if offp:
+                ls = jax.device_put(loss_scale, jax.memory.Space.Host)
+                grads, finite, gnorm = finalize_grads(grads, ls)
+                finite = jax.device_put(finite, jax.memory.Space.Device)
+                gnorm = jax.device_put(gnorm, jax.memory.Space.Device)
+            else:
+                grads = _tree_scale(grads, 1.0 / (loss_scale * gas))
+                flat = jax.tree.leaves(grads)
+                finite = jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat]))
+                gnorm = _global_norm(grads)
+                if clip > 0:
+                    scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                    grads = _tree_scale(grads, scale)
 
             step1 = state["step"] + 1
             lr = self.lr_schedule(step1)
@@ -918,9 +1079,57 @@ class DeepSpeedEngine:
             in_shardings=(self._state_shardings, NamedSharding(self.mesh, batch_spec)),
             donate_argnums=(0,),
         )
-        if not getattr(getattr(self.model, "config", None), "remat_offload", False):
+        mixes_spaces = (
+            getattr(getattr(self.model, "config", None), "remat_offload", False)
+            or self.offload_param_enabled
+        )
+        self._mixes_spaces = mixes_spaces
+        self._check_output_shardings = mixes_spaces
+        self._last_batch_shapes = None
+        if not mixes_spaces:
             kwargs["out_shardings"] = (self._state_shardings, None)
+        else:
+            # output shardings are propagation-derived in this mode; verify
+            # them after each step (_verify_state_shardings; disarmed by the
+            # first clean pass) so a host-memory leaf silently landing back
+            # in device memory can't regress the offload savings unnoticed
+            self._check_output_shardings = True
         return jax.jit(train_step, **kwargs)
+
+    def _verify_state_shardings(self):
+        """Per-step check (remat_offload mode only — output shardings are
+        propagation-derived there) that the state came back with the engine's
+        intended shardings, including memory kind. Drifted leaves are
+        re-placed EVERY step: the compiled executable's output placements are
+        fixed, so a one-shot fix would be undone by the next step. The check
+        itself is host-side sharding metadata comparison (no device work when
+        nothing drifted); the warning fires once."""
+        drifted = []
+
+        def chk(path, leaf, want):
+            if not isinstance(want, NamedSharding) or not hasattr(leaf, "sharding"):
+                return leaf
+            have = leaf.sharding
+            same_kind = getattr(have, "memory_kind", None) == getattr(want, "memory_kind", None)
+            if same_kind and have.is_equivalent_to(want, leaf.ndim):
+                return leaf
+            drifted.append(jax.tree_util.keystr(path))
+            return jax.device_put(leaf, want)
+
+        self.state = jax.tree_util.tree_map_with_path(chk, self.state, self._state_shardings)
+        if not drifted:
+            # the executable's output placements are fixed: one clean pass
+            # proves every later step clean too — disarm the per-step walk
+            # (re-armed if the step is ever rebuilt/recompiled)
+            self._check_output_shardings = False
+        elif not getattr(self, "_sharding_drift_warned", False):
+            self._sharding_drift_warned = True
+            logger.warning(
+                "remat_offload: %d state leaves come back from the compiled "
+                "step with drifted shardings/memory kinds (first: %s); they "
+                "are re-placed after every step — offload savings hold but "
+                "each step pays the copy-back",
+                len(drifted), drifted[0])
 
     # ------------------------------------------------------------------
     def train_batch(self, batch: dict) -> dict:
@@ -936,7 +1145,9 @@ class DeepSpeedEngine:
         """
         if self._nvme_offload:
             return self._train_batch_nvme(batch)
-        if self._train_step is None:
+        if self._onebit_cfg is not None:
+            self._train_step = self._onebit_step_fn()
+        elif self._train_step is None:
             self._train_step = self._build_train_step()
         if self.curriculum_scheduler is not None:
             batch = self._apply_curriculum(batch)
@@ -947,7 +1158,19 @@ class DeepSpeedEngine:
             # per-step sync is the point here — async chaining is the fast path
             self.timers("train_batch").start()
             self.timers("step_dispatch").start()
+        if getattr(self, "_mixes_spaces", False):
+            # a new batch shape means a NEW executable (jit caches per shape,
+            # e.g. under the seqlen curriculum) whose propagation-derived
+            # output placements have not been checked — re-arm the verifier
+            shapes = tuple(getattr(x, "shape", None) for x in jax.tree.leaves(batch))
+            if shapes != self._last_batch_shapes:
+                self._last_batch_shapes = shapes
+                self._check_output_shardings = True
         self.state, metrics = self._train_step(self.state, batch)
+        if self._onebit_cfg is not None:
+            self._train_batch_onebit_account(metrics)
+        if self._check_output_shardings:
+            self._verify_state_shardings()
         if wcb:
             self.timers("step_dispatch").stop()
             # scalar fetch, not block_until_ready: the latter returns early on
@@ -1005,10 +1228,11 @@ class DeepSpeedEngine:
     def _train_batch_nvme(self, batch: dict) -> dict:
         """ZeRO-Infinity step: compiled grads-only program -> host-side Adam
         over NVMe-swapped state groups -> compute-dtype params back to device.
-        Checkpoint contract: the engine checkpoint carries params + the Adam
-        step clock (client_state); on load the tier's masters are rebuilt
-        from the restored params and moments restart from zero
-        (nvme_opt.reset_from) — moments are NOT part of the checkpoint."""
+        Checkpoint contract: save_checkpoint persists the tier's masters +
+        moments + step clock next to the engine checkpoint
+        (nvme_opt.save_state), and load_checkpoint restores them; only for
+        checkpoints lacking the tier files do moments restart from zero with
+        a re-warmed bias-correction clock (loud warning)."""
         if self._train_step is None:
             self._train_step = self._build_train_step(grads_only=True)
         if self.curriculum_scheduler is not None:
@@ -1220,6 +1444,13 @@ class DeepSpeedEngine:
                 "3-call backward/step loop would need per-call compressed "
                 "reductions); forward()/eval_batch() work normally"
             )
+        if self.offload_param_enabled:
+            raise NotImplementedError(
+                "offload_param supports the fused train_batch() path only "
+                "(per-call gradient accumulation would round-trip the host-"
+                "resident gradient tree through HBM); forward()/eval_batch() "
+                "work normally"
+            )
         if self._grad_fn is None:
             self._build_compat_fns()
         g = self._grad_fn(self.state, self._last_batch)
@@ -1291,8 +1522,6 @@ class DeepSpeedEngine:
             global_samples=self.global_samples,
             skipped_steps=self.skipped_steps,
         )
-        if self._nvme_offload:
-            extra["nvme_opt_step_count"] = self.nvme_opt.step_count
         eng = self.checkpoint_engine
         eng.save(
             os.path.join(save_dir, tag),
@@ -1301,6 +1530,11 @@ class DeepSpeedEngine:
             async_save=self._ckpt_async,
             latest=(os.path.join(save_dir, "latest"), tag),
         )
+        if self._nvme_offload and jax.process_index() == 0:
+            # the tier's masters/moments live on NVMe, outside self.state —
+            # persist them too (the reference's ZeRO-Infinity checkpoints
+            # carry swapped optimizer state; resume must not lose moments)
+            self.nvme_opt.save_state(os.path.join(save_dir, tag, "nvme_optimizer"))
         if jax.process_index() == 0:
             # drop the standalone recovery script next to the checkpoint
             # (reference runtime/engine.py:3172 copies zero_to_fp32.py) so
@@ -1391,16 +1625,48 @@ class DeepSpeedEngine:
         self.state = state
         self.global_steps = client_state.get("global_steps", int(jax.device_get(state["step"])))
         self.global_samples = client_state.get("global_samples", 0)
+        if self._onebit_cfg is not None:
+            # host-side phase clock mirrors the device's applied-step counter
+            self._onebit_applied_steps = int(jax.device_get(state["step"]))
         if self._nvme_offload:
-            # resync the NVMe tier to the restored weights — its masters were
-            # built from the fresh init and would otherwise overwrite the
-            # loaded params on the next step
-            params_host = {
-                k: np.asarray(jax.device_get(leaf)).astype(np.float32)
-                for k, leaf in zip(
-                    self._nvme_keys,
-                    jax.tree_util.tree_leaves(self.state["params"]))
-            }
-            self.nvme_opt.reset_from(
-                params_host, step_count=client_state.get("nvme_opt_step_count", 0))
+            state_dir = os.path.join(load_dir, tag, "nvme_optimizer")
+            loaded = self.nvme_opt.load_state(state_dir)
+            if jax.process_count() > 1:
+                # the tier is replicated per process but saved by process 0
+                # only; on a non-shared filesystem some ranks won't see the
+                # files. All ranks must take the SAME branch or their Adam
+                # updates (and then params) silently diverge — agree on the
+                # conjunction.
+                from jax.experimental import multihost_utils
+
+                all_loaded = bool(np.min(multihost_utils.process_allgather(
+                    np.asarray(loaded, np.int8))))
+                if loaded and not all_loaded:
+                    logger.warning(
+                        "NVMe tier state visible on this process but not on "
+                        "all; discarding it for cross-process consistency — "
+                        "use a shared checkpoint filesystem to keep moments")
+                loaded = all_loaded
+            if loaded:
+                log_dist(
+                    f"restored NVMe optimizer tier (masters + moments, "
+                    f"step {self.nvme_opt.step_count}) from {state_dir}",
+                    ranks=[0])
+            else:
+                # legacy/foreign checkpoint without tier files: rebuild
+                # masters from the restored params with ZEROED moments and a
+                # re-warmed bias-correction clock — keeping the saved clock
+                # with m=v=0 would make the first post-resume updates ~3x the
+                # Adam step bound
+                logger.warning(
+                    "checkpoint %s has no nvme_optimizer state; Adam moments "
+                    "restart from zero and the bias-correction clock is reset "
+                    "(convergence will briefly re-warm)", state_dir)
+                params_host = {
+                    k: np.asarray(jax.device_get(leaf)).astype(np.float32)
+                    for k, leaf in zip(
+                        self._nvme_keys,
+                        jax.tree_util.tree_leaves(self.state["params"]))
+                }
+                self.nvme_opt.reset_from(params_host, step_count=0)
         return tag, client_state

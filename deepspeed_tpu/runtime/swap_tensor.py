@@ -36,8 +36,17 @@ class TensorSwapper:
             from ..ops.aio import build_error
 
             raise RuntimeError(f"native aio unavailable: {build_error()}")
+        # Each instance swaps into its own subdirectory: two swappers pointed
+        # at the same nvme_path (two engines in one process, or two processes)
+        # must never collide on sequence numbers and silently read each
+        # other's state. Subdirs are named run-<pid>-<rand>; at init, subdirs
+        # whose pid is no longer alive are reclaimed — a crashed run's tens
+        # of GB of swap files must not accumulate until the device fills.
         os.makedirs(swap_dir, exist_ok=True)
-        self.swap_dir = swap_dir
+        self._reclaim_stale(swap_dir)
+        self.swap_dir = os.path.join(
+            swap_dir, f"run-{os.getpid()}-{os.urandom(4).hex()}")
+        os.makedirs(self.swap_dir, exist_ok=True)
         self.handle = AsyncIOHandle(n_threads=n_threads, use_odirect=use_odirect)
         self._seq = 0
         self._inflight: list[int] = []
@@ -45,6 +54,28 @@ class TensorSwapper:
         self._pinned: dict[int, list[np.ndarray]] = {}
         self._dirty_paths: set[str] = set()
         self._lock = threading.Lock()
+
+    @staticmethod
+    def _reclaim_stale(swap_dir: str) -> None:
+        """Remove run-<pid>-<rand> subdirs whose owning pid is gone (crashed
+        or killed runs); live pids — including other processes sharing the
+        directory — are left alone."""
+        import re
+        import shutil
+
+        for name in os.listdir(swap_dir):
+            m = re.fullmatch(r"run-(\d+)-[0-9a-f]+", name)
+            if not m:
+                continue
+            pid = int(m.group(1))
+            try:
+                os.kill(pid, 0)
+                continue  # alive (or not ours to signal): keep
+            except ProcessLookupError:
+                pass
+            except PermissionError:
+                continue  # alive under another uid
+            shutil.rmtree(os.path.join(swap_dir, name), ignore_errors=True)
 
     # ------------------------------------------------------------------
     def swap_out(self, tree: PyTree, async_op: bool = False) -> dict:

@@ -18,12 +18,25 @@ feeds this optimizer on host.
 
 from __future__ import annotations
 
+import json
 import math
+import os
+import zipfile
 from typing import Any, Optional
 
 import numpy as np
 
 PyTree = Any
+
+
+def _fsync_file(path: str) -> None:
+    """fsync a file or directory by path (directory fsync makes renames
+    durable on POSIX filesystems)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 class NvmeTieredOptimizer:
@@ -81,9 +94,12 @@ class NvmeTieredOptimizer:
         return len(self.groups)
 
     def reset_from(self, params_host: dict[str, np.ndarray], step_count: int = 0):
-        """Resync the tier after a checkpoint load: masters rebuilt from the
-        restored params, moments zeroed (the engine checkpoint does not carry
-        the NVMe moment files), Adam bias-correction clock restored."""
+        """LOSSY resync fallback (no persisted tier state): masters rebuilt
+        from the restored params, moments zeroed. With m=v=0 the very first
+        updates would be ~1/(1-b1) times the Adam step bound if the
+        bias-correction clock kept running, so ``step_count`` must be 0 here
+        (re-warm bias correction) unless the caller restores real moments.
+        The non-lossy path is save_state()/load_state()."""
         old = self.manifests
         self.manifests = []
         for g in self.groups:
@@ -98,6 +114,101 @@ class NvmeTieredOptimizer:
         for m in old:
             self.swapper.release(m)
         self.step_count = int(step_count)
+
+    # ------------------------------------------------------------------
+    # Checkpoint persistence — the reference persists swapped optimizer
+    # state in checkpoints too (ZeRO-Infinity contract:
+    # runtime/zero/stage3.py state_dict carries the swapped-in fp32 state);
+    # without this, resume would silently train with fresh moments.
+    def save_state(self, state_dir: str) -> None:
+        """Write the full tier (fp32 masters + Adam moments + step clock) as
+        one .npz per group under ``state_dir``.
+
+        Crash-consistent: every file lands via tmp + os.replace, each group
+        file carries a per-save generation stamp, and meta.json (holding the
+        same stamp) is written LAST — a save that dies part-way leaves a
+        directory load_state() rejects as a whole instead of silently mixing
+        moments from two different steps."""
+        os.makedirs(state_dir, exist_ok=True)
+        gen = os.urandom(8).hex()
+        gen_arr = np.frombuffer(bytes.fromhex(gen), dtype=np.uint8)
+        for gi, manifest in enumerate(self.manifests):
+            tree = self.swapper.swap_in(manifest)  # one group in RAM at a time
+            flat = {"__gen__": gen_arr}
+            for key, st in tree.items():
+                for comp in ("master", "m", "v"):
+                    flat[f"{key}::{comp}"] = st[comp]
+            path = os.path.join(state_dir, f"group{gi:04d}.npz")
+            np.savez(path + ".tmp.npz", **flat)
+            _fsync_file(path + ".tmp.npz")  # data durable before the rename
+            os.replace(path + ".tmp.npz", path)
+        meta_path = os.path.join(state_dir, "meta.json")
+        with open(meta_path + ".tmp", "w") as f:
+            json.dump({"step_count": self.step_count,
+                       "num_groups": len(self.groups), "gen": gen}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(meta_path + ".tmp", meta_path)
+        _fsync_file(state_dir)  # the renames themselves
+
+    def load_state(self, state_dir: str) -> bool:
+        """Restore the tier from save_state() output; returns False (tier
+        untouched) when the directory is absent, corrupt, from a partial
+        save (generation mismatch), or its grouping does not match this
+        optimizer's partition.
+
+        Two passes: a cheap metadata validation over every group file (npz
+        directory read only), then a streaming swap_out that keeps at most
+        one group's {master, m, v} in host RAM — the same DRAM bound the
+        step path honors."""
+        meta_path = os.path.join(state_dir, "meta.json")
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if int(meta.get("num_groups", -1)) != len(self.groups):
+            return False
+        if not isinstance(meta.get("step_count"), int):
+            return False  # foreign/hand-edited meta: reject before any swap
+        gen = meta.get("gen")
+        paths = [os.path.join(state_dir, f"group{gi:04d}.npz")
+                 for gi in range(len(self.groups))]
+        try:
+            for path, g in zip(paths, self.groups):
+                with np.load(path) as z:
+                    names = set(z.files)
+                    if any(f"{k}::{c}" not in names
+                           for k in g for c in ("master", "m", "v")):
+                        return False
+                    if gen is not None and (
+                        "__gen__" not in names
+                        or bytes(z["__gen__"]).hex() != gen
+                    ):
+                        return False  # partial re-save: mixed generations
+            old = self.manifests
+            new_manifests = []
+            for path, g in zip(paths, self.groups):
+                with np.load(path) as z:
+                    tree = {
+                        k: {"master": z[f"{k}::master"], "m": z[f"{k}::m"],
+                            "v": z[f"{k}::v"]}
+                        for k in g
+                    }
+                new_manifests.append(self.swapper.swap_out(tree))
+            self.swapper.synchronize()
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+            # truncated/corrupt npz: reject the whole directory; the tier is
+            # untouched unless we got past validation, in which case the
+            # partially-written new swap files are dropped
+            for m in locals().get("new_manifests", []):
+                self.swapper.release(m)
+            return False
+        self.manifests = new_manifests
+        for m in old:
+            self.swapper.release(m)
+        self.step_count = int(meta["step_count"])
+        return True
 
     def step(self, grads_host: dict[str, np.ndarray], lr: Optional[float] = None,
              skip: bool = False) -> Optional[dict[str, np.ndarray]]:

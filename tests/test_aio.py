@@ -73,6 +73,31 @@ def test_read_error_raises(tmp_path):
     h.close()
 
 
+def test_tensor_swapper_reclaims_stale_runs(tmp_path):
+    """A crashed run's swap subdir (dead pid) is reclaimed at init; a live
+    run's subdir is left alone."""
+    import os
+    import subprocess
+
+    from deepspeed_tpu.runtime.swap_tensor import TensorSwapper
+
+    base = tmp_path / "swap"
+    base.mkdir()
+    dead = subprocess.Popen(["true"])
+    dead.wait()
+    stale = base / f"run-{dead.pid}-deadbeef"
+    stale.mkdir()
+    (stale / "swap000000.bin").write_bytes(b"x" * 64)
+    live = base / f"run-{os.getpid()}-cafecafe"
+    live.mkdir()
+    (live / "swap000000.bin").write_bytes(b"y" * 64)
+
+    sw = TensorSwapper(str(base))
+    assert not stale.exists()  # dead run reclaimed
+    assert live.exists()  # live pid untouched
+    sw.close()
+
+
 def test_tensor_swapper_roundtrip(tmp_path):
     from deepspeed_tpu.runtime.swap_tensor import TensorSwapper
 

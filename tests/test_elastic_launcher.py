@@ -105,12 +105,23 @@ def test_agent_restarts_on_membership_change(tmp_path):
     )
     import threading
 
+    def _wait_for(pred, timeout=60.0):
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            if pred():
+                return True
+            time.sleep(0.2)
+        return False
+
     def shrink_then_kill():
-        # interpreter startup is seconds here (site hooks); give each
-        # generation time to write its world size before moving on
-        time.sleep(5.0)
+        # event-driven, not sleep-based: interpreter startup can take many
+        # seconds on a loaded box — grow the hostfile only after generation 1
+        # actually recorded its world size, and kill only after generation 2
+        # recorded the grown size
+        _wait_for(lambda: out.exists() and out.read_text().split())
         hostfile.write_text("node-0 slots=4\nnode-1 slots=8\n")
-        time.sleep(8.0)
+        _wait_for(lambda: out.exists() and "12" in out.read_text().split())
+        time.sleep(0.5)
         agent._stop(signal.SIGKILL)
 
     t = threading.Thread(target=shrink_then_kill)

@@ -42,7 +42,7 @@ def test_nvme_optimizer_matches_adamw(tmp_path):
         for k in params:
             np.testing.assert_allclose(new[k], np.asarray(jp[k]), rtol=1e-5, atol=1e-6)
     # states actually live on disk
-    assert glob.glob(os.path.join(str(tmp_path), "swap*.bin"))
+    assert glob.glob(os.path.join(str(tmp_path), "run-*", "swap*.bin"))
     opt.close()
 
 
@@ -77,7 +77,7 @@ def test_engine_nvme_offload_trains(tmp_path):
     batch = random_tokens(16)
     losses = [float(engine.train_batch(batch)["loss"]) for _ in range(5)]
     assert losses[-1] < losses[0], losses
-    assert glob.glob(os.path.join(str(tmp_path), "swap*.bin"))
+    assert glob.glob(os.path.join(str(tmp_path), "run-*", "swap*.bin"))
     assert engine.global_steps == 5
 
 
@@ -116,6 +116,75 @@ def test_engine_nvme_checkpoint_resume(tmp_path):
     assert np.isfinite(float(m["loss"]))
     assert not np.allclose(stepped, trained)  # moved...
     assert np.abs(stepped - trained).max() < 0.1  # ...but from trained, not re-init
+
+    # moments are part of the checkpoint (ADVICE r3): the resumed engine's
+    # next step must match the original engine continuing uninterrupted
+    e1.train_batch(batch)
+    cont = np.asarray(jax.device_get(e1.state["params"]["layers"]["wq"]))
+    np.testing.assert_allclose(stepped, cont, rtol=1e-6, atol=1e-7)
+
+
+def test_nvme_tier_save_load_state_roundtrip(tmp_path):
+    """save_state/load_state carry masters + moments + clock exactly."""
+    from deepspeed_tpu.runtime.zero.nvme_optimizer import NvmeTieredOptimizer
+
+    rng = np.random.default_rng(1)
+    p = {"w": rng.standard_normal((8, 8)).astype(np.float32)}
+    g = {"w": rng.standard_normal((8, 8)).astype(np.float32)}
+    a = NvmeTieredOptimizer(dict(p), lr=1e-2, swap_dir=str(tmp_path / "a"))
+    for _ in range(3):
+        wa = a.step(g)["w"]
+    a.save_state(str(tmp_path / "state"))
+
+    b = NvmeTieredOptimizer(dict(p), lr=1e-2, swap_dir=str(tmp_path / "b"))
+    assert b.load_state(str(tmp_path / "state"))
+    assert b.step_count == a.step_count == 3
+    np.testing.assert_allclose(a.step(g)["w"], b.step(g)["w"], rtol=1e-7)
+    # missing dir -> False, tier untouched
+    c = NvmeTieredOptimizer(dict(p), lr=1e-2, swap_dir=str(tmp_path / "c"))
+    assert not c.load_state(str(tmp_path / "nope"))
+    a.close(); b.close(); c.close()
+
+
+def test_nvme_tier_rejects_partial_or_corrupt_state(tmp_path):
+    """A crash mid-re-save (mixed generations) or a truncated group file must
+    fail load_state as a whole, leaving the tier stepping from its own
+    state — never silently mixing moments from two saves."""
+    from deepspeed_tpu.runtime.zero.nvme_optimizer import NvmeTieredOptimizer
+
+    rng = np.random.default_rng(2)
+    # two groups so cross-generation mixing is possible
+    p = {"w1": rng.standard_normal((64,)).astype(np.float32),
+         "w2": rng.standard_normal((64,)).astype(np.float32)}
+    g = {k: np.ones_like(v) for k, v in p.items()}
+    a = NvmeTieredOptimizer(dict(p), lr=1e-2, swap_dir=str(tmp_path / "a"),
+                            sub_group_bytes=64 * 4)
+    assert a.num_groups == 2
+    a.step(g)
+    a.save_state(str(tmp_path / "s1"))
+    a.step(g)
+    a.save_state(str(tmp_path / "s2"))
+
+    # simulate crash mid-re-save: s2's meta + group0, s1's group1
+    import shutil
+    mixed = tmp_path / "mixed"
+    shutil.copytree(str(tmp_path / "s2"), str(mixed))
+    shutil.copyfile(str(tmp_path / "s1" / "group0001.npz"),
+                    str(mixed / "group0001.npz"))
+    b = NvmeTieredOptimizer(dict(p), lr=1e-2, swap_dir=str(tmp_path / "b"),
+                            sub_group_bytes=64 * 4)
+    assert not b.load_state(str(mixed))
+    assert b.step_count == 0  # untouched
+
+    # truncated group file
+    trunc = tmp_path / "trunc"
+    shutil.copytree(str(tmp_path / "s2"), str(trunc))
+    with open(trunc / "group0000.npz", "r+b") as f:
+        f.truncate(40)
+    assert not b.load_state(str(trunc))
+    out = b.step(g)  # tier still functional from its own state
+    assert np.all(np.isfinite(out["w1"]))
+    a.close(); b.close()
 
 
 def test_nvme_adam_vs_adamw_decay_semantics(tmp_path):

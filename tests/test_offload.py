@@ -90,15 +90,116 @@ def test_offload_zero3_composes():
     assert np.isfinite(float(jax.device_get(m["loss"])))
 
 
-def test_offload_param_rejected():
+def test_offload_param_requires_offloaded_optimizer():
     cfg = _cfg(False)
     cfg["zero_optimization"]["offload_param"] = {"device": "cpu"}
     tcfg = TransformerConfig(
         vocab_size=128, max_seq_len=64, num_layers=2, num_heads=2, hidden_size=32,
         dtype=jnp.bfloat16, loss_chunk_size=0,
     )
-    with pytest.raises(NotImplementedError, match="offload_param"):
+    with pytest.raises(ValueError, match="offload_param requires offload_optimizer"):
         deepspeed_tpu.initialize(model=Model(tcfg), config=cfg)
+
+
+def _param_offload_engine(stage=1, gas=1, nvme_dir=None, **tover):
+    tcfg = TransformerConfig(
+        vocab_size=128, max_seq_len=64, num_layers=2, num_heads=2, hidden_size=32,
+        dtype=jnp.bfloat16, loss_chunk_size=0, **tover,
+    )
+    cfg = _cfg(True, stage)
+    cfg["zero_optimization"]["offload_param"] = {"device": "cpu"}
+    if nvme_dir is not None:
+        cfg["zero_optimization"]["offload_param"] = {"device": "nvme"}
+        cfg["zero_optimization"]["offload_optimizer"] = {
+            "device": "nvme", "nvme_path": str(nvme_dir)}
+    cfg["train_batch_size"] = 8 * gas
+    cfg["gradient_accumulation_steps"] = gas
+    engine, _, _, _ = deepspeed_tpu.initialize(model=Model(tcfg), config=cfg)
+    return engine
+
+
+def test_offload_param_trains_and_matches_unoffloaded():
+    """ZeRO-Infinity param tier (VERDICT r3 #1): params stream per layer;
+    the training trajectory must match the plain offload engine exactly —
+    the tier only moves WHERE tensors live."""
+    b = _batch()
+    e_p = _param_offload_engine(gas=1)
+    assert e_p.offload_param_enabled
+    assert e_p.model.config.param_offload  # engine wired the model streaming
+    cfg_ref = _cfg(True, 1)
+    cfg_ref["train_batch_size"] = 8
+    cfg_ref["gradient_accumulation_steps"] = 1
+    tcfg = TransformerConfig(
+        vocab_size=128, max_seq_len=64, num_layers=2, num_heads=2, hidden_size=32,
+        dtype=jnp.bfloat16, loss_chunk_size=0,
+    )
+    e_r, _, _, _ = deepspeed_tpu.initialize(model=Model(tcfg), config=cfg_ref)
+    lp, lr_ = [], []
+    for _ in range(4):
+        lp.append(float(jax.device_get(e_p.train_batch(b)["loss"])))
+        lr_.append(float(jax.device_get(e_r.train_batch(b)["loss"])))
+    np.testing.assert_allclose(lp, lr_, rtol=2e-2)
+    assert lp[-1] < lp[0]
+
+
+def test_offload_param_gas_accumulates_on_host():
+    """gas > 1: the gradient accumulator lives on the host tier; training
+    still converges."""
+    b = _batch()
+    e = _param_offload_engine(gas=2)
+    losses = [float(jax.device_get(e.train_batch(b)["loss"])) for _ in range(4)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_offload_param_zero3_composes():
+    e = _param_offload_engine(stage=3)
+    m = e.train_batch(_batch())
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
+def test_offload_param_remat_composes():
+    e = _param_offload_engine(remat=True, remat_policy="nothing_saveable")
+    m = e.train_batch(_batch())
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+
+
+def test_offload_param_nvme_tier(tmp_path):
+    """HBM <- DRAM <- NVMe: bf16 working set host-resident, fp32 masters +
+    moments on disk."""
+    pytest.importorskip("deepspeed_tpu.ops.aio")
+    from deepspeed_tpu.ops.aio import aio_available
+
+    if not aio_available():
+        pytest.skip("native aio unavailable")
+    b = _batch()
+    e = _param_offload_engine(nvme_dir=tmp_path)
+    losses = [float(jax.device_get(e.train_batch(b)["loss"])) for _ in range(4)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_offload_param_pipeline_rejected():
+    """The pipelined loss path does not stream params — the gate must refuse
+    rather than compile a mixed-space program that only fails on TPU."""
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
+    from deepspeed_tpu.pipe import PipelineEngine, PipelinedTransformer
+
+    tcfg = TransformerConfig(
+        vocab_size=128, max_seq_len=64, num_layers=2, num_heads=2, hidden_size=32,
+        dtype=jnp.bfloat16, loss_chunk_size=0,
+    )
+    model = PipelinedTransformer(tcfg, num_stages=2, num_micro_batches=2)
+    mesh = build_mesh(MeshConfig(pipe=2, data=-1))
+    cfg = _cfg(True, 1)
+    cfg["zero_optimization"]["offload_param"] = {"device": "cpu"}
+    with pytest.raises(NotImplementedError, match="pipeline"):
+        PipelineEngine(model=model, config=cfg, mesh=mesh)
+
+
+def test_offload_param_compat_loop_gated():
+    e = _param_offload_engine()
+    e.forward(_batch())  # eval path works
+    with pytest.raises(NotImplementedError, match="train_batch"):
+        e.backward()
 
 
 def test_memory_estimators():
