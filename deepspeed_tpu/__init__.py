@@ -36,6 +36,8 @@ def initialize(
     model_parameters=None,
     optimizer=None,
     lr_scheduler=None,
+    training_data=None,
+    collate_fn=None,
     dist_init_required=None,
     **kwargs,
 ):
@@ -43,8 +45,11 @@ def initialize(
 
     Returns ``(engine, optimizer, dataloader, lr_scheduler)`` for signature
     parity; in the TPU-native design the optimizer and schedule are compiled
-    into the engine's train step, so the extra slots return the engine's
-    handles (optimizer=engine, lr_scheduler=engine.lr_schedule).
+    into the engine's train step, so those slots return the engine's handles
+    (optimizer=engine, lr_scheduler=engine.lr_schedule). When
+    ``training_data`` is given, the third slot is a real DP-sharded
+    ``DeepSpeedDataLoader`` over it (reference __init__.py:56 returns the
+    engine's deepspeed_io loader the same way); otherwise it is None.
     """
     cfg = config if config is not None else config_params
     if cfg is None and args is not None:
@@ -54,7 +59,11 @@ def initialize(
     engine = DeepSpeedEngine(
         model=model, config=cfg, mesh=mesh, rng=rng, params=model_parameters, **kwargs
     )
-    return engine, engine, None, engine.lr_schedule
+    dataloader = None
+    if training_data is not None:
+        io_kw = {"collate_fn": collate_fn} if collate_fn is not None else {}
+        dataloader = engine.deepspeed_io(training_data, **io_kw)
+    return engine, engine, dataloader, engine.lr_schedule
 
 
 def init_inference(model=None, config=None, **kwargs):

@@ -1,1 +1,2 @@
 from .autotuner import DEFAULT_SPACE, Autotuner, Trial, TuneResult  # noqa: F401
+from .scheduler import ExperimentScheduler, spec_key  # noqa: F401

@@ -279,3 +279,113 @@ class Autotuner:
         if results_path:
             result.save(results_path)
         return result
+
+    # -- isolated (subprocess) experiments ---------------------------------
+    def _spec_for(self, overrides: dict, model_cfg: dict, batch: dict) -> dict:
+        mc = dict(model_cfg)
+        policy = overrides.get("remat_policy")
+        if policy is not None:
+            if policy == "none":
+                mc["remat"] = False
+            else:
+                mc["remat"] = True
+                mc["remat_policy"] = policy
+        return {
+            "model_cfg": mc,
+            "ds_config": self._apply_overrides(overrides),
+            "batch": dict(batch),
+            "steps": self.steps,
+            "warmup": self.warmup,
+        }
+
+    def _surrogate_sort(self, candidates: list[dict], observed: list[Trial]) -> list[dict]:
+        """Model-based tuner (reference tuner/model_based_tuner.py:14): fit a
+        regressor on measured trials and explore the best PREDICTED next.
+        One-hot features + ridge least-squares replace the reference's
+        XGBoost cost model — same shape, no dependency. Failed trials train
+        the model at 0 tok/s, steering the search away from their region."""
+        keys = sorted({k for t in observed for k in t.overrides} |
+                      {k for c in candidates for k in c})
+        vocab = {k: sorted({str(t.overrides.get(k)) for t in observed} |
+                           {str(c.get(k)) for c in candidates}) for k in keys}
+
+        def feat(ov):
+            v = [1.0]
+            for k in keys:
+                for val in vocab[k]:
+                    v.append(1.0 if str(ov.get(k)) == val else 0.0)
+            return v
+
+        X = np.array([feat(t.overrides) for t in observed])
+        y = np.array([t.tokens_per_sec if t.status == "ok" else 0.0 for t in observed])
+        lam = 1e-3
+        A = X.T @ X + lam * np.eye(X.shape[1])
+        w = np.linalg.solve(A, X.T @ y)
+        scored = [(float(np.array(feat(c)) @ w), c) for c in candidates]
+        return [c for _, c in sorted(scored, key=lambda sc: -sc[0])]
+
+    def tune_isolated(
+        self,
+        model_cfg: dict,
+        batch: dict,
+        scheduler,
+        space: Optional[dict] = None,
+        strategy: str = "surrogate",
+        max_trials: int = 12,
+        results_path: Optional[str] = None,
+        seed: int = 0,
+    ) -> TuneResult:
+        """Experiment-scheduler sweep: every trial is a fresh SUBPROCESS with
+        a hard timeout (scheduler.ExperimentScheduler — the reference
+        ResourceManager's job isolation), so an OOM/hang candidate is a
+        recorded failure, not a dead tuner, and a restarted sweep resumes
+        from the experiment log.
+
+        ``model_cfg``: TransformerConfig kwargs (dtype as 'bfloat16'/'float32'
+        string); ``batch``: {'size': B, 'seq': S, 'vocab': V}.
+        ``strategy``: 'surrogate' bootstraps with the analytic cost model,
+        then re-ranks remaining candidates after every observation with the
+        fitted surrogate; 'model_based'/'grid'/'random' order once, up front.
+        """
+        space = space or DEFAULT_SPACE
+        candidates = self._expand(space)
+        if strategy == "random":
+            pyrandom.Random(seed).shuffle(candidates)
+        elif strategy in ("model_based", "surrogate"):
+            candidates = [c for _, c in sorted(
+                ((self._cost_rank(c), c) for c in candidates), key=lambda rc: rc[0])]
+        elif strategy != "grid":
+            raise ValueError(f"unknown strategy {strategy!r}")
+
+        result = TuneResult(best=None)
+        bootstrap = 3  # observations before the surrogate takes over
+        while candidates and len(result.trials) < max_trials:
+            ok_seen = [t for t in result.trials if t.status == "ok"]
+            if strategy == "surrogate" and len(ok_seen) >= bootstrap:
+                candidates = self._surrogate_sort(candidates, result.trials)
+            overrides = candidates.pop(0)
+            log_dist(
+                f"autotune[isolated] trial {len(result.trials) + 1}/{max_trials}: "
+                f"{overrides}", ranks=[0])
+            rec = scheduler.run_trial(self._spec_for(overrides, model_cfg, batch))
+            trial = Trial(
+                overrides=overrides,
+                tokens_per_sec=float(rec.get("tokens_per_sec", 0.0)),
+                step_ms=float(rec.get("step_ms", 0.0)),
+                status="ok" if rec.get("status") == "ok" else "failed",
+            )
+            if rec.get("status") != "ok":
+                trial.error = f"[{rec.get('status')}] {rec.get('error', '')}"[:400]
+            result.trials.append(trial)
+            if trial.status == "ok" and (
+                result.best is None
+                or trial.tokens_per_sec > result.best.tokens_per_sec
+            ):
+                result.best = trial
+        if result.best is not None:
+            log_dist(
+                f"autotune[isolated] best: {result.best.overrides} -> "
+                f"{result.best.tokens_per_sec:,.0f} tok/s", ranks=[0])
+        if results_path:
+            result.save(results_path)
+        return result

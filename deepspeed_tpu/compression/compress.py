@@ -181,6 +181,16 @@ def init_compression(model, params, ds_config: dict, _finalize: bool = False):
         params = apply_head_pruning(params, float(hp.get("ratio", 0.25)))
         log_dist(f"compression: head pruning ratio {hp.get('ratio', 0.25)}", ranks=[0])
 
+    aq = _shared(comp.get("activation_quantization"))
+    if aq.get("enabled"):
+        bits = int(aq.get("aq_bits", aq.get("bits", 8)))
+        symmetric = aq.get("quantization_type", "symmetric") == "symmetric"
+        cfg = cfg.replace(act_quant_bits=bits, act_quant_symmetric=symmetric)
+        log_dist(
+            f"compression: activation quantization int{bits} "
+            f"({'symmetric' if symmetric else 'asymmetric'}, dynamic range, "
+            "straight-through gradient)", ranks=[0])
+
     wq = _shared(comp.get("weight_quantization"))
     if wq.get("enabled"):
         bits = int(wq.get("target_bits", wq.get("bits", 8)))

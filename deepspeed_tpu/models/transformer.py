@@ -73,6 +73,11 @@ class TransformerConfig:
     # slice (see quantize_weights / _dequant_layer).
     weight_bits: int = 0
     weight_group_size: int = 64
+    # activation quantization (compression: reference basic_layer.py:12
+    # QuantAct): fake-quantize the inputs of the layer's linear projections
+    # (qkv, attn-out, ffn up/down) with a straight-through gradient. 0 = off.
+    act_quant_bits: int = 0
+    act_quant_symmetric: bool = True
     remat: bool = False  # activation checkpointing over the layer scan
     # Remat policy names: any jax.checkpoint_policies attr, plus
     #   "save_flash"      — save only the flash kernel's out/lse residuals so
@@ -501,43 +506,62 @@ def _attention_dispatch(cfg: TransformerConfig):
     return lambda q, k, v, bias: xla_attention(q, k, v, bias=bias, causal=cfg.causal)
 
 
+def _act_q(cfg, x):
+    """Activation fake-quant at linear-projection inputs (compression's
+    activation_quantization group; reference QuantAct basic_layer.py:12)."""
+    if not cfg.act_quant_bits:
+        return x
+    from ..ops.quantization import fake_quant_act
+
+    return fake_quant_act(x, cfg.act_quant_bits, cfg.act_quant_symmetric)
+
+
 def _ffn(cfg, lp, h):
-    u = jnp.einsum("bsd,df->bsf", h, lp["wi"].astype(h.dtype))
-    if cfg.use_bias:
-        u = u + lp["bi"].astype(h.dtype)
-    if cfg.activation == "relu":
-        u = jax.nn.relu(u)
-    elif cfg.activation == "gelu_exact":
-        u = jax.nn.gelu(u, approximate=False)
-    else:
-        u = jax.nn.gelu(u, approximate=True)
-    out = jnp.einsum("bsf,fd->bsd", u, lp["wo_mlp"].astype(h.dtype))
-    if cfg.use_bias:
-        out = out + lp["bo_mlp"].astype(h.dtype)
-    return out
+    # named_scope feeds the flops profiler's per-module tree (profiling/
+    # flops_profiler: reference print_model_profile parity)
+    with jax.named_scope("ffn"):
+        h = _act_q(cfg, h)
+        u = jnp.einsum("bsd,df->bsf", h, lp["wi"].astype(h.dtype))
+        if cfg.use_bias:
+            u = u + lp["bi"].astype(h.dtype)
+        if cfg.activation == "relu":
+            u = jax.nn.relu(u)
+        elif cfg.activation == "gelu_exact":
+            u = jax.nn.gelu(u, approximate=False)
+        else:
+            u = jax.nn.gelu(u, approximate=True)
+        u = _act_q(cfg, u)
+        out = jnp.einsum("bsf,fd->bsd", u, lp["wo_mlp"].astype(h.dtype))
+        if cfg.use_bias:
+            out = out + lp["bo_mlp"].astype(h.dtype)
+        return out
 
 
 def _qkv_proj(cfg: TransformerConfig, lp, h, positions):
     """LN'd hidden states -> rotary-embedded q, k, v [B, T, H, Dh]."""
-    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(h.dtype))
-    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(h.dtype))
-    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(h.dtype))
-    if cfg.use_bias:
-        q = q + lp["bq"].astype(h.dtype)
-        k = k + lp["bk"].astype(h.dtype)
-        v = v + lp["bv"].astype(h.dtype)
-    if cfg.pos_emb == "rotary":
-        rd = int(cfg.head_dim * cfg.rotary_pct)
-        q = rotary_embed(q, positions, rd, interleaved=cfg.rotary_interleaved)
-        k = rotary_embed(k, positions, rd, interleaved=cfg.rotary_interleaved)
-    return q, k, v
+    with jax.named_scope("attn"):
+        h = _act_q(cfg, h)
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"].astype(h.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"].astype(h.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"].astype(h.dtype))
+        if cfg.use_bias:
+            q = q + lp["bq"].astype(h.dtype)
+            k = k + lp["bk"].astype(h.dtype)
+            v = v + lp["bv"].astype(h.dtype)
+        if cfg.pos_emb == "rotary":
+            rd = int(cfg.head_dim * cfg.rotary_pct)
+            q = rotary_embed(q, positions, rd, interleaved=cfg.rotary_interleaved)
+            k = rotary_embed(k, positions, rd, interleaved=cfg.rotary_interleaved)
+        return q, k, v
 
 
 def _attn_out_proj(cfg: TransformerConfig, lp, attn_out):
-    out = jnp.einsum("bshk,hkd->bsd", attn_out, lp["wo"].astype(attn_out.dtype))
-    if cfg.use_bias:
-        out = out + lp["bo"].astype(attn_out.dtype)
-    return out
+    with jax.named_scope("attn"):
+        attn_out = _act_q(cfg, attn_out)
+        out = jnp.einsum("bshk,hkd->bsd", attn_out, lp["wo"].astype(attn_out.dtype))
+        if cfg.use_bias:
+            out = out + lp["bo"].astype(attn_out.dtype)
+        return out
 
 
 def quantizable_layer_leaves(layers: dict, group_size: int) -> dict[str, int]:
@@ -686,15 +710,16 @@ def _layer_body(cfg: TransformerConfig, attn_fn, carry, lp, alibi_bias, position
 
 def embed(cfg: TransformerConfig, params: Params, tokens, positions=None):
     """Token (+ learned position) embedding -> (x [B,S,d], positions [B,S])."""
-    B, S = tokens.shape
-    if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
-    x = params["wte"][tokens].astype(cfg.dtype)
-    if cfg.pos_emb == "learned":
-        x = x + params["wpe"][positions].astype(cfg.dtype)
-    if cfg.embed_ln:
-        x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"], cfg.layernorm_epsilon)
-    return x, positions
+    with jax.named_scope("embed"):
+        B, S = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        x = params["wte"][tokens].astype(cfg.dtype)
+        if cfg.pos_emb == "learned":
+            x = x + params["wpe"][positions].astype(cfg.dtype)
+        if cfg.embed_ln:
+            x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"], cfg.layernorm_epsilon)
+        return x, positions
 
 
 def attn_bias(cfg: TransformerConfig, S: int):
@@ -835,13 +860,14 @@ def apply(
         x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon)
     if return_hidden:
         return (x, aux_total) if with_aux else x
-    head = params.get("lm_head", None)
-    if head is None:
-        head = params["wte"].T
-    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
-    logits = logits.astype(jnp.float32)
-    if "lm_head_bias" in params:
-        logits = logits + params["lm_head_bias"].astype(jnp.float32)
+    with jax.named_scope("lm_head"):
+        head = params.get("lm_head", None)
+        if head is None:
+            head = params["wte"].T
+        logits = jnp.einsum("bsd,dv->bsv", x, head.astype(x.dtype))
+        logits = logits.astype(jnp.float32)
+        if "lm_head_bias" in params:
+            logits = logits + params["lm_head_bias"].astype(jnp.float32)
     return (logits, aux_total) if with_aux else logits
 
 

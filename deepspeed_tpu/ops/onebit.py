@@ -14,12 +14,11 @@ compress + sync phase inside ``shard_map`` over the dp axes
 per-device. Error-feedback state is carried as a [dp, ...] leading-axis
 pytree sharded over the dp axes — each device sees exactly its own slice.
 
-Transport honesty: XLA collectives have no sub-byte dtype, so the sign
-tensor travels as bf16 (±1) + an fp32 scale — 2x less volume than the fp32
-gradient psum, with exactly the 1-bit algorithm's convergence semantics
-(sign + scale + error feedback + frozen variance). Bit-packing the signs
-into a uint8 all_gather would recover the remaining factor; the algorithm
-would be unchanged.
+Transport: the sign tensor is bit-packed 8-per-byte into a uint8 all_gather
+plus one fp32 scale per tensor (comm/compressed.py pack_signs — the
+reference's cupy uint8 packing, nccl.py:76) — 32x less volume than the fp32
+gradient psum it replaces, with exactly the 1-bit algorithm's convergence
+semantics (sign + scale + error feedback + frozen variance).
 """
 
 from __future__ import annotations

@@ -1,0 +1,207 @@
+"""1-bit LAMB — error-feedback sign-compressed momentum with LAMB scaling.
+
+Reference: ``OnebitLamb`` (runtime/fp16/onebit/lamb.py:11): baseline LAMB
+during warmup; after ``freeze_step`` the variance is FROZEN and only the
+momentum is communicated, sign-compressed with error feedback. Because the
+compressed stage can no longer compute a trustworthy per-layer trust ratio
+from fresh statistics, the reference (and we) carry three warmup artifacts
+into the frozen stage:
+
+- ``scaling_coeff`` — per-tensor momentum pre-scaler (united RMS / tensor
+  RMS, lamb.py:169-181) so the single L1 scale of the FLATTENED fused
+  momentum buffer compresses every layer equally well;
+- ``lamb_coeff_freeze`` — an EMA (``coeff_beta``) of the warmup trust
+  ratios, the frozen stage's baseline coefficient;
+- ``v_fresh`` (reference ``exp_avg_sq_fresh``) — a live variance estimate
+  rebuilt from momentum-reconstructed gradients, whose ratio to the frozen
+  variance gives the per-step ``factor`` that modulates the frozen
+  coefficient (lamb.py:352-383), clamped to ``factor_min..factor_max`` and
+  rate-limited by ``factor_threshold``.
+
+TPU-native: the grad + momentum-sync phase runs per-device inside
+``shard_map`` (runtime/engine.py _build_onebit_train_step routes here); the
+momentum pytree is FLATTENED to one vector and compressed with a single
+scale + one [dp, N] error-feedback buffer — the reference's fused
+``exp_avg_flat`` layout — through the shared bit-packed 1-bit kernel
+(comm/compressed.py). The replicated LAMB update runs outside.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+
+@dataclass(frozen=True)
+class OneBitLambConfig:
+    lr: float = 1e-3
+    betas: tuple = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    freeze_step: int = 100
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+    coeff_beta: float = 0.9
+    factor_max: float = 4.0
+    factor_min: float = 0.5
+    factor_threshold: float = 0.1
+
+    @classmethod
+    def from_params(cls, p: dict) -> "OneBitLambConfig":
+        return cls(
+            lr=float(p.get("lr", 1e-3)),
+            betas=tuple(p.get("betas", (0.9, 0.999))),
+            eps=float(p.get("eps", 1e-8)),
+            weight_decay=float(p.get("weight_decay", 0.0)),
+            freeze_step=int(p.get("freeze_step", 100)),
+            max_coeff=float(p.get("max_coeff", 10.0)),
+            min_coeff=float(p.get("min_coeff", 0.01)),
+            coeff_beta=float(p.get("coeff_beta", 0.9)),
+            factor_max=float(p.get("factor_max", 4.0)),
+            factor_min=float(p.get("factor_min", 0.5)),
+            factor_threshold=float(p.get("factor_threshold", 0.1)),
+        )
+
+
+def init_state(params, dp: int):
+    """m/v/v_fresh and the per-tensor scalars replicated; ONE flat
+    error-feedback buffer with a [dp] leading axis (the reference's fused
+    ``exp_avg_flat`` + ``worker_errors`` layout, lamb.py:259-295)."""
+    zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+    scalars = lambda v: jax.tree.map(lambda _: jnp.asarray(v, jnp.float32), params)
+    n_total = sum(p.size for p in jax.tree.leaves(params))
+    return {
+        "m": jax.tree.map(zeros, params),
+        "v": jax.tree.map(zeros, params),
+        "v_fresh": jax.tree.map(zeros, params),
+        "error": {"flat": jnp.zeros((dp, n_total), jnp.float32)},
+        "scaling_coeff": scalars(1.0),
+        "lamb_coeff_freeze": scalars(0.0),
+        "last_factor": scalars(1.0),
+    }
+
+
+def on_freeze(opt, cfg: OneBitLambConfig):
+    """Warm→frozen transition (host-level, jit it once): snapshot the frozen
+    variance and compute the per-tensor momentum scaling coefficients
+    (lamb.py:166-181: united RMS over all tensors / this tensor's RMS)."""
+    rms = [
+        jnp.linalg.norm(m) / jnp.sqrt(float(m.size)) for m in jax.tree.leaves(opt["m"])
+    ]
+    united = sum(rms) / len(rms)
+    flat, treedef = jax.tree.flatten(opt["m"])
+    coeffs = jax.tree.unflatten(
+        treedef, [united / jnp.maximum(r, 1e-16) for r in rms]
+    )
+    return {**opt, "v_fresh": opt["v"], "scaling_coeff": coeffs}
+
+
+def momentum_sync(g_local, opt, cfg: OneBitLambConfig, dp_axes, frozen: bool):
+    """Per-device phase (inside shard_map): returns the new opt pytree.
+
+    warm:   m/v from the pmean'd gradient — baseline LAMB moments
+    frozen: v untouched; each momentum is scaled by its ``scaling_coeff``,
+            the whole pytree flattened, 1-bit-compressed ONCE (one scale for
+            the fused buffer, like the reference's flattened allreduce),
+            averaged, unscaled.
+    """
+    b1, b2 = cfg.betas
+    if not frozen:
+        def leaf(g, m, v):
+            g_avg = lax.pmean(g, dp_axes)
+            return b1 * m + (1.0 - b1) * g_avg, b2 * v + (1.0 - b2) * g_avg * g_avg
+
+        out = jax.tree.map(leaf, g_local, opt["m"], opt["v"])
+        m_new = jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        v_new = jax.tree.map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        return {**opt, "m": m_new, "v": v_new}
+
+    from ..comm.compressed import compressed_allreduce_p
+
+    m_loc = jax.tree.map(
+        lambda g, m, c: (b1 * m + (1.0 - b1) * g) * c,
+        g_local, opt["m"], opt["scaling_coeff"],
+    )
+    flat, unravel = ravel_pytree(m_loc)
+    avg_flat, err_new = compressed_allreduce_p(flat, opt["error"]["flat"][0], dp_axes)
+    m_new = jax.tree.map(
+        lambda m, c: m / c, unravel(avg_flat), opt["scaling_coeff"]
+    )
+    return {**opt, "m": m_new, "error": {"flat": err_new[None]}}
+
+
+def apply_update(params, opt_prev, opt_new, lr, cfg: OneBitLambConfig, frozen: bool):
+    """Replicated LAMB update (outside shard_map). Returns (params', opt'').
+
+    warm (lamb.py:225-247): update = m/(sqrt(v)+eps) [+ wd·p]; trust ratio
+    clamped to [min_coeff, max_coeff]; EMA of the ratio accumulates into
+    ``lamb_coeff_freeze``.
+
+    frozen (lamb.py:328-386): frozen-variance update modulated by ``factor``
+    = max(denom/denom_fresh) where the fresh variance integrates gradients
+    reconstructed from the synchronized momentum delta."""
+    b1, b2 = cfg.betas
+    wd = cfg.weight_decay
+
+    if not frozen:
+        def leaf(p, m, v, lcf):
+            update = m / (jnp.sqrt(v) + cfg.eps)
+            if wd > 0.0:
+                update = update + wd * p
+            wnorm = jnp.linalg.norm(p)
+            unorm = jnp.linalg.norm(update)
+            coeff = jnp.where(
+                (wnorm > 0) & (unorm > 0),
+                jnp.clip(wnorm / jnp.maximum(unorm, 1e-16), cfg.min_coeff, cfg.max_coeff),
+                1.0,
+            )
+            lcf_new = jnp.where(
+                coeff != 1.0, cfg.coeff_beta * lcf + (1.0 - cfg.coeff_beta) * coeff, lcf
+            )
+            return p - lr * coeff * update, lcf_new
+
+        out = jax.tree.map(
+            leaf, params, opt_new["m"], opt_new["v"], opt_prev["lamb_coeff_freeze"]
+        )
+        is2 = lambda x: isinstance(x, tuple)
+        p_new = jax.tree.map(lambda o: o[0], out, is_leaf=is2)
+        lcf = jax.tree.map(lambda o: o[1], out, is_leaf=is2)
+        return p_new, {**opt_new, "lamb_coeff_freeze": lcf}
+
+    def leaf(p, m_new, m_prev, v, vf, lcf, last):
+        g_rec = (m_new - m_prev * b1) / (1.0 - b1)
+        vf_new = b2 * vf + (1.0 - b2) * g_rec * g_rec
+        denom = jnp.sqrt(v) + cfg.eps
+        update_prelim = m_new / denom
+        update = update_prelim + wd * p if wd > 0.0 else update_prelim
+        denom_real = jnp.sqrt(vf_new) + cfg.eps
+        factor = jnp.max(denom / denom_real)
+        if wd > 0.0:
+            ur = jnp.minimum(
+                1.0,
+                jnp.linalg.norm(update_prelim)
+                / jnp.maximum(jnp.linalg.norm(update), 1e-16),
+            )
+            factor = factor * ur + (1.0 - ur)
+        factor = jnp.clip(factor, cfg.factor_min, cfg.factor_max)
+        factor = jnp.clip(
+            factor,
+            last * (1.0 - cfg.factor_threshold),
+            last * (1.0 + cfg.factor_threshold),
+        )
+        coeff = lcf * factor
+        return p - lr * coeff * update, vf_new, factor
+
+    out = jax.tree.map(
+        leaf, params, opt_new["m"], opt_prev["m"], opt_new["v"],
+        opt_prev["v_fresh"], opt_prev["lamb_coeff_freeze"], opt_prev["last_factor"],
+    )
+    is3 = lambda x: isinstance(x, tuple)
+    p_new = jax.tree.map(lambda o: o[0], out, is_leaf=is3)
+    vf = jax.tree.map(lambda o: o[1], out, is_leaf=is3)
+    last = jax.tree.map(lambda o: o[2], out, is_leaf=is3)
+    return p_new, {**opt_new, "v_fresh": vf, "last_factor": last}

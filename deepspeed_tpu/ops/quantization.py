@@ -124,6 +124,29 @@ def fake_quant(x, bits=8, group_size=128, symmetric=True, stochastic=False, rng=
     return out.reshape(x.shape).astype(x.dtype)
 
 
+def fake_quant_act(x, bits: int = 8, symmetric: bool = True):
+    """Activation fake-quant with a straight-through gradient — the QAT
+    forward of the reference's ``QuantAct`` (compression/basic_layer.py:12).
+
+    Per-tensor DYNAMIC range (this batch's min/max): equivalent to QuantAct
+    with ``act_range_momentum=0``. The reference's momentum-tracked static
+    range only changes inference latency behavior, which the int8 inference
+    path here handles separately via weight/KV quantization."""
+    xf = x.astype(jnp.float32)
+    qmax = float(2 ** (bits - 1) - 1)
+    sg = jax.lax.stop_gradient
+    if symmetric:
+        absmax = jnp.max(jnp.abs(sg(xf)))
+        scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+        q = jnp.clip(jnp.round(xf / scale), -qmax - 1, qmax) * scale
+    else:
+        lo, hi = jnp.min(sg(xf)), jnp.max(sg(xf))
+        scale = jnp.where(hi > lo, (hi - lo) / (2 * qmax + 1), 1.0)
+        q = jnp.clip(jnp.round((xf - lo) / scale), 0, 2 * qmax + 1) * scale + lo
+    # STE: forward sees q, backward sees identity
+    return (xf + sg(q - xf)).astype(x.dtype)
+
+
 def pack_int4(values: jnp.ndarray) -> jnp.ndarray:
     """int8 array of int4 values [-8, 7], even last dim -> packed uint8 of
     half the size (low nibble first)."""

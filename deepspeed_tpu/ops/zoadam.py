@@ -1,0 +1,211 @@
+"""0/1 Adam — compressed + LOCAL-step Adam (https://arxiv.org/abs/2202.06009).
+
+Reference: ``ZeroOneAdam`` (runtime/fp16/onebit/zoadam.py:10). Two phases:
+
+Variance phase (step <= var_freeze_step, "warm"):
+  - on steps hitting the variance grid (step % var_interval == 0): the DENSE
+    pmean'd gradient updates both moments (reference toggles
+    enable_backward_allreduce for exactly these steps);
+  - off-grid steps: the gradient itself is 1-bit compressed (error feedback)
+    and only the momentum is updated;
+  - ``var_interval`` doubles every ``var_update_scaler`` grid hits, so
+    variance refreshes on an exponentially sparsifying grid.
+
+Local-step phase (after var_freeze_step, "frozen"):
+  - variance frozen; each rank updates its momentum and parameters from its
+    OWN gradient with NO communication at all, accumulating the applied
+    deltas in ``u`` (the paper's momentum accumulator);
+  - every ``local_step_interval`` steps the accumulated delta is converted
+    to momentum units (× (sqrt(v)+eps)), 1-bit compressed-allreduced,
+    averaged into every rank's parameters, and the momentum is rebuilt as
+    -u_avg / sum(lr) (zoadam.py:252-276);
+  - ``local_step_interval`` doubles every ``local_step_scaler`` steps,
+    clipped at ``local_step_clipper``.
+
+TPU-native: between syncs parameters genuinely DIVERGE per data-parallel
+rank. Instead of materializing per-rank parameter copies, the engine keeps
+``state['params']`` at the last SYNCED value and carries the per-rank delta
+``u`` with a [dp] leading axis sharded over the dp axes — the rank's live
+parameters are ``params + u`` inside shard_map, and memory per device is one
+extra fp32 param-copy (exactly the reference's fused momentum accumulator).
+The engine compiles one program per (phase, on-grid) pair and switches
+host-side via :class:`ZeroOneClock`, mirroring the reference's Python-side
+interval counters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class ZeroOneAdamConfig:
+    lr: float = 1e-3
+    betas: tuple = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    var_freeze_step: int = 100000
+    var_update_scaler: int = 16
+    local_step_scaler: int = 32678
+    local_step_clipper: int = 16
+
+    @classmethod
+    def from_params(cls, p: dict) -> "ZeroOneAdamConfig":
+        return cls(
+            lr=float(p.get("lr", 1e-3)),
+            betas=tuple(p.get("betas", (0.9, 0.999))),
+            eps=float(p.get("eps", 1e-8)),
+            weight_decay=float(p.get("weight_decay", 0.0)),
+            var_freeze_step=int(p.get("var_freeze_step", 100000)),
+            var_update_scaler=int(p.get("var_update_scaler", 16)),
+            local_step_scaler=int(p.get("local_step_scaler", 32678)),
+            local_step_clipper=int(p.get("local_step_clipper", 16)),
+        )
+
+
+class ZeroOneClock:
+    """Host-side mirror of the reference's per-state interval counters
+    (zoadam.py:175-187, 278-301). Purely deterministic in the applied-step
+    count, so checkpoint resume just replays it (:meth:`replay`)."""
+
+    def __init__(self, cfg: ZeroOneAdamConfig):
+        self.cfg = cfg
+        self.step = 0  # applied optimizer steps so far
+        self.var_interval = 1
+        self.var_counter = 0
+        self.local_interval = 1
+        self.local_counter = 0
+
+    def _frozen(self, step: int) -> bool:
+        # reference flips freeze_key at the END of the step where
+        # state['step'] > var_freeze_step, so the first frozen step is
+        # var_freeze_step + 2
+        return step > self.cfg.var_freeze_step + 1
+
+    def next_phase(self):
+        """Phase key for the NEXT applied step: ('warm', var_update) or
+        ('frozen', sync)."""
+        s = self.step + 1
+        if not self._frozen(s):
+            return ("warm", s % self.var_interval == 0)
+        return ("frozen", s % self.local_interval == 0)
+
+    def advance(self):
+        """Account one APPLIED step (call only when the step was finite)."""
+        self.step += 1
+        s = self.step
+        if not self._frozen(s):
+            if s % self.var_interval == 0:
+                self.var_counter += 1
+                if self.var_counter == self.cfg.var_update_scaler:
+                    self.var_counter = 0
+                    self.var_interval *= 2
+        else:
+            self.local_counter += 1
+            if self.local_counter == self.cfg.local_step_scaler:
+                self.local_counter = 0
+                self.local_interval = min(
+                    self.cfg.local_step_clipper, self.local_interval * 2
+                )
+
+    @classmethod
+    def replay(cls, cfg: ZeroOneAdamConfig, step: int) -> "ZeroOneClock":
+        clock = cls(cfg)
+        for _ in range(step):
+            clock.advance()
+        return clock
+
+
+def init_state(params, dp: int):
+    """m, u, error carry a [dp] leading axis (per-rank values — m diverges in
+    the local-step phase, u is the per-rank accumulated delta, error the
+    per-rank compression residual); v and the lr accumulator are replicated."""
+    zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+    stacked = lambda p: jnp.zeros((dp,) + p.shape, jnp.float32)
+    return {
+        "m": jax.tree.map(stacked, params),
+        "v": jax.tree.map(zeros, params),
+        "u": jax.tree.map(stacked, params),
+        "error": jax.tree.map(stacked, params),
+        "lrs": jnp.zeros((), jnp.float32),
+    }
+
+
+def on_freeze(opt):
+    """Variance→local-step transition: zero the error-feedback buffers —
+    from now on they track accumulated-update compression, a different
+    metric (reference ``reinitial_error_buffer``, zoadam.py:308-315)."""
+    return {**opt, "error": jax.tree.map(jnp.zeros_like, opt["error"])}
+
+
+def device_step(g, params, opt, lr, cfg: ZeroOneAdamConfig, dp_axes, phase):
+    """One 0/1 Adam step for THIS rank (inside shard_map over the dp axes).
+
+    ``opt`` leaves under m/u/error arrive with their [1] rank slice leading
+    axis; v and lrs replicated. Returns (params', opt') where params' is
+    rank-identical (the engine re-exports it replicated) on warm and sync
+    steps, and UNCHANGED on frozen local steps (the divergent live value is
+    params + u).
+    """
+    b1, b2 = cfg.betas
+    kind, on_grid = phase
+    sq = lambda v: jnp.sqrt(v) + cfg.eps
+    m, u, err = (jax.tree.map(lambda x: x[0], opt[k]) for k in ("m", "u", "error"))
+    v = opt["v"]
+    from ..comm.compressed import compressed_allreduce_p
+
+    if kind == "warm":
+        if on_grid:
+            g_avg = jax.tree.map(lambda x: lax.pmean(x, dp_axes), g)
+            v = jax.tree.map(lambda v_, ga: b2 * v_ + (1 - b2) * ga * ga, v, g_avg)
+            m = jax.tree.map(lambda m_, ga: b1 * m_ + (1 - b1) * ga, m, g_avg)
+        else:
+            pairs = jax.tree.map(
+                lambda g_, e_: compressed_allreduce_p(g_, e_, dp_axes), g, err
+            )
+            is2 = lambda x: isinstance(x, tuple)
+            g_1bit = jax.tree.map(lambda o: o[0], pairs, is_leaf=is2)
+            err = jax.tree.map(lambda o: o[1], pairs, is_leaf=is2)
+            m = jax.tree.map(lambda m_, gb: b1 * m_ + (1 - b1) * gb, m, g_1bit)
+        # replicated Adam update, no bias correction (reference zoadam step)
+        upd = jax.tree.map(lambda m_, v_: m_ / sq(v_), m, v)
+        if cfg.weight_decay > 0.0:
+            upd = jax.tree.map(lambda u_, p: u_ + cfg.weight_decay * p, upd, params)
+        params = jax.tree.map(lambda p, u_: p - lr * u_, params, upd)
+        new_lrs = opt["lrs"]
+    else:
+        # local momentum + local parameter delta; live params = params + u
+        live = jax.tree.map(lambda p, u_: p + u_, params, u)
+        m = jax.tree.map(lambda m_, g_: b1 * m_ + (1 - b1) * g_, m, g)
+        upd = jax.tree.map(lambda m_, v_: m_ / sq(v_), m, v)
+        if cfg.weight_decay > 0.0:
+            upd = jax.tree.map(lambda u_, p: u_ + cfg.weight_decay * p, upd, live)
+        u = jax.tree.map(lambda u_, d: u_ - lr * d, u, upd)
+        new_lrs = opt["lrs"] + lr
+        if on_grid:  # sync: average the accumulated deltas in momentum units
+            w = jax.tree.map(lambda u_, v_: u_ * sq(v_), u, v)
+            pairs = jax.tree.map(
+                lambda w_, e_: compressed_allreduce_p(w_, e_, dp_axes), w, err
+            )
+            is2 = lambda x: isinstance(x, tuple)
+            w_avg = jax.tree.map(lambda o: o[0], pairs, is_leaf=is2)
+            err = jax.tree.map(lambda o: o[1], pairs, is_leaf=is2)
+            m = jax.tree.map(lambda w_: -w_ / jnp.maximum(new_lrs, 1e-16), w_avg)
+            params = jax.tree.map(
+                lambda p, w_, v_: p + w_ / sq(v_), params, w_avg, v
+            )
+            u = jax.tree.map(jnp.zeros_like, u)
+            new_lrs = jnp.zeros((), jnp.float32)
+
+    opt_new = {
+        "m": jax.tree.map(lambda x: x[None], m),
+        "v": v,
+        "u": jax.tree.map(lambda x: x[None], u),
+        "error": jax.tree.map(lambda x: x[None], err),
+        "lrs": new_lrs,
+    }
+    return params, opt_new

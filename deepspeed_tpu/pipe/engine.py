@@ -70,16 +70,24 @@ def pipeline_apply(stage_fn, stage_params, x_mb, num_stages: int, mesh: Optional
         n = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
         return ("data", "fsdp") if n > 1 and dim % n == 0 else None
 
+    # Non-batch dims stay UNCONSTRAINED: pinning seq/hidden to replicated
+    # here while context-parallel attention shards seq inside stage_fn made
+    # the partitioner bounce the clock-loop buffers between incompatible
+    # device orders — an '[SPMD] Involuntary full rematerialization' (a
+    # whole-tensor replicate) every tick (MULTICHIP_r04 / VERDICT r4 #6).
+    # Leaving them open lets one consistent layout flow through the loop.
+    U = PartitionSpec.UNCONSTRAINED
+
     def constrain_stage(t):
         if mesh is None or mesh.shape.get("pipe", 1) == 1:
             return t
-        spec = PartitionSpec("pipe", _batch_axes(t.shape[1]))
+        spec = PartitionSpec("pipe", _batch_axes(t.shape[1]), *([U] * (t.ndim - 2)))
         return lax.with_sharding_constraint(t, NamedSharding(mesh, spec))
 
     def constrain_mb(t):
         if mesh is None:
             return t
-        spec = PartitionSpec(None, _batch_axes(t.shape[1]))
+        spec = PartitionSpec(None, _batch_axes(t.shape[1]), *([U] * (t.ndim - 2)))
         return lax.with_sharding_constraint(t, NamedSharding(mesh, spec))
 
     buf = jnp.zeros((S,) + mb_shape, dtype)  # activation entering each stage

@@ -52,36 +52,54 @@ _ELEMENTWISE = {
 _TRANSCENDENTAL = {"exp", "log", "tanh", "logistic", "erf", "rsqrt", "sqrt", "sin", "cos", "pow"}
 
 
-def count_jaxpr_flops(jaxpr) -> tuple[float, dict[str, float]]:
-    """(total_flops, per-primitive breakdown). Matmul-dominated by design —
-    the reference's table (:481-700) similarly counts GEMM/conv exactly and
-    elementwise ops as one FLOP per output element."""
+def _eqn_scope(eqn) -> str:
+    """Named-scope path of an equation ('layer/attn'), from the trace-time
+    name stack that ``jax.named_scope`` annotations leave on each eqn."""
+    try:
+        return str(eqn.source_info.name_stack)
+    except AttributeError:
+        return ""
+
+
+def count_jaxpr_flops(jaxpr) -> tuple[float, dict[str, float], dict[str, float]]:
+    """(total_flops, per-primitive breakdown, per-named-scope breakdown).
+
+    Matmul-dominated by design — the reference's table (:481-700) similarly
+    counts GEMM/conv exactly and elementwise ops as one FLOP per output
+    element. Scopes come from ``jax.named_scope`` annotations in the model
+    (the TPU-native stand-in for the reference's module-tree walk,
+    profiler.py:235): an eqn inside a length-L ``lax.scan`` counts L times
+    under its scope, so per-layer rows reflect the whole stacked model."""
     total = 0.0
     by_prim: dict[str, float] = {}
+    by_scope: dict[str, float] = {}
 
-    def visit(jx):
+    def add(eqn, f, mult):
         nonlocal total
+        f *= mult
+        total += f
+        name = eqn.primitive.name
+        by_prim[name] = by_prim.get(name, 0.0) + f
+        scope = _eqn_scope(eqn)
+        by_scope[scope] = by_scope.get(scope, 0.0) + f
+
+    def visit(jx, mult):
         for eqn in jx.eqns:
             name = eqn.primitive.name
             if name in ("pjit", "custom_vjp_call", "custom_jvp_call", "remat", "checkpoint", "custom_vjp_call_jaxpr", "closed_call"):
                 inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
                 if inner is not None:
-                    visit(inner.jaxpr if hasattr(inner, "jaxpr") else inner)
+                    visit(inner.jaxpr if hasattr(inner, "jaxpr") else inner, mult)
                 continue
             if name in ("scan", "while", "cond"):
-                mult = eqn.params.get("length", 1) if name == "scan" else 1
+                body_mult = mult * (eqn.params.get("length", 1) if name == "scan" else 1)
                 for key in ("jaxpr", "body_jaxpr", "cond_jaxpr", "branches"):
                     inner = eqn.params.get(key)
                     if inner is None:
                         continue
                     inners = inner if isinstance(inner, (tuple, list)) else [inner]
                     for sub in inners:
-                        before = total
-                        visit(sub.jaxpr if hasattr(sub, "jaxpr") else sub)
-                        if name == "scan" and mult > 1:
-                            extra = (total - before) * (mult - 1)
-                            total += extra
-                            by_prim["scan_body"] = by_prim.get("scan_body", 0.0) + extra
+                        visit(sub.jaxpr if hasattr(sub, "jaxpr") else sub, body_mult)
                 continue
             if name == "dot_general":
                 f = _dot_general_flops(eqn)
@@ -96,11 +114,26 @@ def count_jaxpr_flops(jaxpr) -> tuple[float, dict[str, float]]:
             else:
                 f = 0.0
             if f:
-                total += f
-                by_prim[name] = by_prim.get(name, 0.0) + f
+                add(eqn, f, mult)
 
-    visit(jaxpr)
-    return total, by_prim
+    visit(jaxpr, 1.0)
+    return total, by_prim, by_scope
+
+
+def scope_tree(by_scope: dict[str, float]) -> dict:
+    """Fold flat 'a/b/c' scope paths into a nested tree of
+    ``{'flops': subtree_total, 'children': {...}}`` nodes. FLOPs recorded at
+    an interior scope surface as its own row AND roll up into ancestors, so
+    every level's children (+ own unattributed remainder) sum to the node."""
+    root = {"flops": 0.0, "children": {}}
+    for path, f in by_scope.items():
+        parts = [p for p in path.split("/") if p] if path else []
+        node = root
+        node["flops"] += f
+        for part in parts:
+            node = node["children"].setdefault(part, {"flops": 0.0, "children": {}})
+            node["flops"] += f
+    return root
 
 
 def _num(x: float, suffix: str = "") -> str:
@@ -118,6 +151,7 @@ class ProfileResult:
     latency_s: Optional[float]
     by_primitive: dict[str, float]
     xla_flops: Optional[float] = None
+    by_scope: dict[str, float] = field(default_factory=dict)
 
     @property
     def tflops_per_sec(self) -> Optional[float]:
@@ -140,7 +174,7 @@ class FlopsProfiler:
 
     def profile(self, fn: Callable, *args, time_it: bool = True, params: Any = None) -> ProfileResult:
         closed = jax.make_jaxpr(fn)(*args)
-        flops, by_prim = count_jaxpr_flops(closed.jaxpr)
+        flops, by_prim, by_scope = count_jaxpr_flops(closed.jaxpr)
 
         n_params = 0
         if params is not None:
@@ -164,9 +198,14 @@ class FlopsProfiler:
             out = jitted(*args)
             jax.block_until_ready(out)
             latency = time.perf_counter() - t0
-        return ProfileResult(flops, n_params, latency, by_prim, xla_flops)
+        return ProfileResult(flops, n_params, latency, by_prim, xla_flops, by_scope)
 
-    def print_model_profile(self, res: ProfileResult, detailed: bool = True, output_file=None):
+    def print_model_profile(self, res: ProfileResult, detailed: bool = True,
+                            depth: int = -1, top_modules: int = 0, output_file=None):
+        """Aggregates + per-primitive table + the reference-style
+        depth-limited per-module tree (profiler.py:235 print_model_profile:
+        each row is a named scope with its FLOPs and share; ``depth`` limits
+        nesting, ``top_modules`` keeps only the largest rows per level)."""
         lines = [
             "-" * 60,
             "deepspeed_tpu flops profiler (reference: flops-profiler)",
@@ -184,6 +223,24 @@ class FlopsProfiler:
             for k, v in sorted(res.by_primitive.items(), key=lambda kv: -kv[1]):
                 share = 100.0 * v / max(res.total_flops, 1.0)
                 lines.append(f"  {k:24s} {_num(v, 'FLOPs'):>14s}  {share:5.1f}%")
+        if detailed and res.by_scope and any(k for k in res.by_scope):
+            lines.append("per-module breakdown (named scopes):")
+            tree = scope_tree(res.by_scope)
+
+            def emit(node, indent, d):
+                kids = sorted(node["children"].items(), key=lambda kv: -kv[1]["flops"])
+                if top_modules > 0:
+                    kids = kids[:top_modules]
+                for name, child in kids:
+                    share = 100.0 * child["flops"] / max(res.total_flops, 1.0)
+                    lines.append(
+                        f"{'  ' * indent}  {name:<{max(24 - 2 * indent, 4)}s} "
+                        f"{_num(child['flops'], 'FLOPs'):>14s}  {share:5.1f}%"
+                    )
+                    if d != 0:
+                        emit(child, indent + 1, d - 1)
+
+            emit(tree, 0, depth if depth >= 0 else -1)
         lines.append("-" * 60)
         text = "\n".join(lines)
         if output_file:

@@ -281,27 +281,42 @@ class DeepSpeedEngine:
         # ---- optimizer -------------------------------------------------------
         opt_cfg = self.config.optimizer
         self._onebit_cfg = None
+        self._onebit_kind = None
         opt_type = opt_cfg.type.lower()
-        if opt_type == "onebitadam":
-            # Real 1-bit Adam (reference onebit/adam.py:10): error-feedback
-            # sign-compressed momentum sync via shard_map over the dp axes —
-            # NOT a silent alias of plain Adam (VERDICT r02 weak #5).
-            from ..ops.onebit import OneBitAdamConfig
-
+        if opt_type in ("onebitadam", "onebitlamb", "zerooneadam"):
+            # The full 1-bit family (reference onebit/{adam,lamb,zoadam}.py):
+            # error-feedback sign-compressed communication via shard_map over
+            # the dp axes — NOT silent aliases of dense optimizers.
             if self.zero_stage > 1:
                 raise ValueError(
-                    "onebitadam requires zero stage 0/1 (the reference has the "
+                    f"{opt_type} requires zero stage 0/1 (the reference has the "
                     "same restriction): momentum must be replicated to compress"
                 )
             if self.offload_optimizer_enabled or self._nvme_offload:
-                raise NotImplementedError("onebitadam with offload_optimizer is unsupported")
+                raise NotImplementedError(f"{opt_type} with offload_optimizer is unsupported")
             if self.offload_param_enabled:
                 raise NotImplementedError(
-                    "onebitadam with offload_param is unsupported (replicated "
+                    f"{opt_type} with offload_param is unsupported (replicated "
                     "momenta live on device)")
-            self._onebit_cfg = OneBitAdamConfig.from_params(opt_cfg.params)
+            if opt_type == "onebitadam":
+                from ..ops.onebit import OneBitAdamConfig
+
+                self._onebit_kind = "adam"
+                self._onebit_cfg = OneBitAdamConfig.from_params(opt_cfg.params)
+            elif opt_type == "onebitlamb":
+                from ..ops.onebit_lamb import OneBitLambConfig
+
+                self._onebit_kind = "lamb"
+                self._onebit_cfg = OneBitLambConfig.from_params(opt_cfg.params)
+            else:
+                from ..ops.zoadam import ZeroOneAdamConfig, ZeroOneClock
+
+                self._onebit_kind = "zoadam"
+                self._onebit_cfg = ZeroOneAdamConfig.from_params(opt_cfg.params)
+                self._zo_clock = ZeroOneClock(self._onebit_cfg)
             self._onebit_applied_steps = 0
-            self._onebit_steps: dict[bool, Any] = {}
+            self._onebit_froze = False  # warm->frozen transition hook ran
+            self._onebit_steps: dict[Any, Any] = {}
             mcfg = getattr(model, "config", None)
             if mcfg is not None and (
                 getattr(mcfg, "hidden_dropout", 0.0) > 0
@@ -309,18 +324,12 @@ class DeepSpeedEngine:
                 or getattr(mcfg, "pld_enabled", False)
             ):
                 raise NotImplementedError(
-                    "onebitadam + dropout/progressive-layer-drop is not wired "
+                    f"{opt_type} + dropout/progressive-layer-drop is not wired "
                     "up (the compressed step does not thread rng/step); "
                     "disable them or use adam/adamw"
                 )
             self.opt_init = self.opt_update = None
             base_lr = self._onebit_cfg.lr
-        elif opt_type in ("onebitlamb", "zerooneadam"):
-            raise NotImplementedError(
-                f"{opt_cfg.type} is not implemented; use OneBitAdam (implemented), "
-                "Lamb, or Adam — silently substituting a different optimizer "
-                "would change convergence semantics"
-            )
         else:
             self.opt_init, self.opt_update, base_lr = get_optimizer(opt_cfg.type, opt_cfg.params)
         self.lr_schedule = get_schedule(
@@ -347,20 +356,34 @@ class DeepSpeedEngine:
 
         # Optimizer state lives on the ZeRO shards: mirror opt specs per leaf.
         if self._onebit_cfg is not None:
-            from ..ops.onebit import init_state as onebit_init
-
             dp = data_parallel_size(self.mesh)
-            rep = jax.tree.map(lambda _: PartitionSpec(), axes_tree,
-                               is_leaf=lambda x: x is None or isinstance(x, tuple))
-            self.opt_specs = {
-                "m": rep,
-                "v": rep,
-                "error": jax.tree.map(
-                    lambda _: PartitionSpec(("data", "fsdp")), axes_tree,
-                    is_leaf=lambda x: x is None or isinstance(x, tuple),
-                ),
-            }
+            is_spec = lambda x: x is None or isinstance(x, tuple)
+            rep = jax.tree.map(lambda _: PartitionSpec(), axes_tree, is_leaf=is_spec)
+            stacked = jax.tree.map(
+                lambda _: PartitionSpec(("data", "fsdp")), axes_tree, is_leaf=is_spec
+            )
+            if self._onebit_kind == "adam":
+                from ..ops.onebit import init_state as onebit_init
+
+                self.opt_specs = {"m": rep, "v": rep, "error": stacked}
+            elif self._onebit_kind == "lamb":
+                from ..ops.onebit_lamb import init_state as onebit_init
+
+                self.opt_specs = {
+                    "m": rep, "v": rep, "v_fresh": rep,
+                    "error": {"flat": PartitionSpec(("data", "fsdp"))},
+                    "scaling_coeff": rep, "lamb_coeff_freeze": rep,
+                    "last_factor": rep,
+                }
+            else:  # zoadam: per-rank momentum / delta accumulator / residual
+                from ..ops.zoadam import init_state as onebit_init
+
+                self.opt_specs = {
+                    "m": stacked, "v": rep, "u": stacked, "error": stacked,
+                    "lrs": PartitionSpec(),
+                }
             opt_shardings = shd.tree_shardings(self.mesh, self.opt_specs)
+            self._onebit_opt_shardings = opt_shardings
             opt_state = jax.jit(
                 partial(onebit_init, dp=dp), out_shardings=opt_shardings
             )(params)
@@ -691,10 +714,11 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------
     def _build_onebit_train_step(self, frozen: bool):
-        """1-bit Adam train step: the grad + compress + momentum-sync phase
-        runs per-device inside shard_map over (data, fsdp) — the local
+        """1-bit Adam/LAMB train step: the grad + compress + momentum-sync
+        phase runs per-device inside shard_map over (data, fsdp) — the local
         gradients a compressor needs are invisible under plain pjit — then
-        the replicated parameter update runs outside (ops/onebit.py).
+        the replicated parameter update runs outside (ops/onebit.py,
+        ops/onebit_lamb.py).
 
         One program is compiled PER PHASE (``frozen``) and the engine
         switches host-side at freeze_step (reference onebit/adam.py keeps
@@ -702,30 +726,49 @@ class DeepSpeedEngine:
         contains no fp32 gradient all-reduce."""
         from jax import shard_map
 
-        from ..ops import onebit as ob
-
         cfg = self.config
         mesh = self.mesh
         gas = self.gradient_accumulation_steps
         compute_dtype = cfg.compute_dtype
         model = self.model
         obc = self._onebit_cfg
+        kind = self._onebit_kind
         dp_axes = ("data", "fsdp")
         fp16 = cfg.fp16
         if cfg.gradient_clipping > 0 and not getattr(self, "_onebit_clip_warned", False):
             self._onebit_clip_warned = True
             log_dist(
-                "onebitadam: gradient_clipping is not applied in the compressed "
+                f"onebit{kind}: gradient_clipping is not applied in the compressed "
                 "stage (the sign compression bounds update magnitude); warmup "
                 "follows the same rule for consistency",
                 ranks=[0],
             )
 
+        if kind == "adam":
+            from ..ops import onebit as ob
+
+            def sync_fn(g, opt):
+                m, v, err = ob.momentum_sync(
+                    g, opt["m"], opt["v"], opt["error"], obc, dp_axes, frozen
+                )
+                return {"m": m, "v": v, "error": err}
+
+            def apply_fn(params, opt_prev, opt_new, step1, lr):
+                p = ob.apply_update(params, opt_new["m"], opt_new["v"], step1, lr, obc)
+                return p, opt_new
+        else:  # lamb
+            from ..ops import onebit_lamb as obl
+
+            def sync_fn(g, opt):
+                return obl.momentum_sync(g, opt, obc, dp_axes, frozen)
+
+            def apply_fn(params, opt_prev, opt_new, step1, lr):
+                return obl.apply_update(params, opt_prev, opt_new, lr, obc, frozen)
+
         P = PartitionSpec
         rep = lambda tree: jax.tree.map(lambda _: P(), tree)
         params_P = rep(self.state["params"])
-        mv_P = rep(self.state["opt"]["m"])
-        err_P = jax.tree.map(lambda _: P(("data", "fsdp")), self.state["opt"]["error"])
+        opt_P = self.opt_specs
         batch_P = self.batch_spec  # pytree prefix: applies to every batch leaf
 
         def loss_fn(params, mb, loss_scale):
@@ -735,7 +778,7 @@ class DeepSpeedEngine:
             loss = model.loss(cast, mb)
             return loss * loss_scale, loss
 
-        def sharded_phase(params, m, v, error, batch, loss_scale):
+        def sharded_phase(params, opt, batch, loss_scale):
             def reshape_leaf(x):
                 return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
 
@@ -767,27 +810,25 @@ class DeepSpeedEngine:
                 dp_axes,
             )
             gnorm = jnp.sqrt(gsq)
-            m_new, v_new, err_new = ob.momentum_sync(g, m, v, error, obc, dp_axes, frozen)
-            return loss, finite, gnorm, m_new, v_new, err_new
+            return loss, finite, gnorm, sync_fn(g, opt)
 
         sm = shard_map(
             sharded_phase,
             mesh=mesh,
-            in_specs=(params_P, mv_P, mv_P, err_P, batch_P, P()),
-            out_specs=(P(), P(), P(), mv_P, mv_P, err_P),
+            in_specs=(params_P, opt_P, batch_P, P()),
+            out_specs=(P(), P(), P(), opt_P),
             check_vma=False,
         )
 
         def train_step(state, batch):
             step1 = state["step"] + 1
             loss_scale = state["loss_scale"]
-            loss, finite_i, gnorm, m_new, v_new, err_new = sm(
-                state["params"], state["opt"]["m"], state["opt"]["v"],
-                state["opt"]["error"], batch, loss_scale,
+            loss, finite_i, gnorm, opt_new = sm(
+                state["params"], state["opt"], batch, loss_scale,
             )
             finite = finite_i > 0
             lr = self.lr_schedule(step1)
-            new_params = ob.apply_update(state["params"], m_new, v_new, step1, lr, obc)
+            new_params, opt_new = apply_fn(state["params"], state["opt"], opt_new, step1, lr)
 
             if self.fp16_enabled and fp16.loss_scale == 0:
                 new_scale, good, hyst = _dynamic_loss_scale(
@@ -796,15 +837,126 @@ class DeepSpeedEngine:
             else:
                 good, new_scale, hyst = state["good_steps"], loss_scale, state["hysteresis"]
 
-            new_opt = {
-                "m": _tree_where(finite, m_new, state["opt"]["m"]),
-                "v": _tree_where(finite, v_new, state["opt"]["v"]),
-                "error": _tree_where(finite, err_new, state["opt"]["error"]),
-            }
             new_state = {
                 "step": jnp.where(finite, step1, state["step"]),
                 "params": _tree_where(finite, new_params, state["params"]),
-                "opt": new_opt,
+                "opt": _tree_where(finite, opt_new, state["opt"]),
+                "loss_scale": new_scale,
+                "good_steps": good,
+                "skipped": state["skipped"] + (~finite).astype(jnp.int32),
+                "hysteresis": hyst,
+            }
+            metrics = {
+                "loss": loss,
+                "grad_norm": gnorm,
+                "lr": lr,
+                "loss_scale": loss_scale,
+                "overflow": ~finite,
+            }
+            return new_state, metrics
+
+        return self._jit_step(train_step, self.batch_spec)
+
+    def _build_zoadam_train_step(self, phase):
+        """0/1 Adam train step (ops/zoadam.py). The WHOLE step — grads at the
+        rank-LIVE parameters (synced params + this rank's accumulated local
+        delta), momentum, parameter math, and any compressed sync — runs
+        per-device inside shard_map: in the local-step phase each rank's
+        parameters genuinely diverge, which plain pjit cannot express.
+
+        One program per (phase kind, grid hit): 'warm'/var-update steps carry
+        a dense pmean, 'warm'/off-grid a 1-bit gradient allreduce,
+        'frozen'/local NO gradient communication at all, 'frozen'/sync the
+        1-bit accumulated-delta allreduce. ZeroOneClock picks the program
+        host-side like the reference's interval counters."""
+        from jax import shard_map
+
+        from ..ops import zoadam as zo
+
+        cfg = self.config
+        mesh = self.mesh
+        gas = self.gradient_accumulation_steps
+        compute_dtype = cfg.compute_dtype
+        model = self.model
+        obc = self._onebit_cfg
+        dp_axes = ("data", "fsdp")
+        fp16 = cfg.fp16
+        kind, _on_grid = phase
+
+        P = PartitionSpec
+        rep = lambda tree: jax.tree.map(lambda _: P(), tree)
+        params_P = rep(self.state["params"])
+        opt_P = self.opt_specs
+        batch_P = self.batch_spec
+
+        def loss_fn(params, mb, loss_scale):
+            cast = jax.tree.map(
+                lambda p: p.astype(compute_dtype) if p.dtype == jnp.float32 else p, params
+            )
+            loss = model.loss(cast, mb)
+            return loss * loss_scale, loss
+
+        def sharded_phase(params, opt, batch, loss_scale, lr):
+            live = params
+            if kind == "frozen":
+                live = jax.tree.map(lambda p, u: p + u[0], params, opt["u"])
+
+            def reshape_leaf(x):
+                return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
+
+            batch_g = jax.tree.map(reshape_leaf, batch)
+            zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+            def micro(carry, mb):
+                g_acc, l_acc = carry
+                (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    live, mb, loss_scale
+                )
+                return (_tree_add(g_acc, grads), l_acc + loss), None
+
+            (g, loss_sum), _ = jax.lax.scan(
+                micro, (zero, jnp.zeros((), jnp.float32)), batch_g
+            )
+            g = _tree_scale(g, 1.0 / (loss_scale * gas))
+            loss = lax.pmean(loss_sum / gas, dp_axes)
+            finite_local = jnp.all(
+                jnp.stack([jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(g)])
+            )
+            finite = lax.pmin(finite_local.astype(jnp.int32), dp_axes)
+            gsq = lax.pmean(
+                jnp.sum(jnp.stack([jnp.sum(jnp.square(x)) for x in jax.tree.leaves(g)])),
+                dp_axes,
+            )
+            gnorm = jnp.sqrt(gsq)
+            params_new, opt_new = zo.device_step(g, params, opt, lr, obc, dp_axes, phase)
+            return loss, finite, gnorm, params_new, opt_new
+
+        sm = shard_map(
+            sharded_phase,
+            mesh=mesh,
+            in_specs=(params_P, opt_P, batch_P, P(), P()),
+            out_specs=(P(), P(), P(), params_P, opt_P),
+            check_vma=False,
+        )
+
+        def train_step(state, batch):
+            step1 = state["step"] + 1
+            loss_scale = state["loss_scale"]
+            lr = self.lr_schedule(step1)
+            loss, finite_i, gnorm, new_params, opt_new = sm(
+                state["params"], state["opt"], batch, loss_scale, lr,
+            )
+            finite = finite_i > 0
+            if self.fp16_enabled and fp16.loss_scale == 0:
+                new_scale, good, hyst = _dynamic_loss_scale(
+                    finite, loss_scale, state["good_steps"], state["hysteresis"], fp16
+                )
+            else:
+                good, new_scale, hyst = state["good_steps"], loss_scale, state["hysteresis"]
+            new_state = {
+                "step": jnp.where(finite, step1, state["step"]),
+                "params": _tree_where(finite, new_params, state["params"]),
+                "opt": _tree_where(finite, opt_new, state["opt"]),
                 "loss_scale": new_scale,
                 "good_steps": good,
                 "skipped": state["skipped"] + (~finite).astype(jnp.int32),
@@ -865,25 +1017,65 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # Fused train step
     # ------------------------------------------------------------------
+    def _onebit_phase(self):
+        """Phase key for the NEXT applied step. adam/lamb: ('warm',) or
+        ('frozen',) around freeze_step; zoadam: ZeroOneClock's
+        (kind, grid-hit) pair."""
+        if self._onebit_kind == "zoadam":
+            return self._zo_clock.next_phase()
+        nxt = self._onebit_applied_steps + 1
+        return ("frozen" if nxt > self._onebit_cfg.freeze_step else "warm",)
+
     def _onebit_step_fn(self):
         """Phase-specialized compiled step for the CURRENT host-side applied
-        step count: warm (exact Adam, fp32 pmean) through freeze_step,
-        compressed after. One cached executable per phase."""
-        frozen = (self._onebit_applied_steps + 1) > self._onebit_cfg.freeze_step
-        fn = self._onebit_steps.get(frozen)
+        step count (warm / compressed / local, per algorithm). One cached
+        executable per phase key."""
+        phase = self._onebit_phase()
+        if phase[0] == "frozen" and not self._onebit_froze:
+            self._onebit_run_freeze_hook()
+        fn = self._onebit_steps.get(phase)
         if fn is None:
-            fn = self._onebit_steps[frozen] = self._build_onebit_train_step(frozen)
+            if self._onebit_kind == "zoadam":
+                fn = self._build_zoadam_train_step(phase)
+            else:
+                fn = self._build_onebit_train_step(frozen=phase[0] == "frozen")
+            self._onebit_steps[phase] = fn
         return fn
+
+    def _onebit_run_freeze_hook(self):
+        """One-shot warm→frozen transition on the live optimizer state:
+        lamb computes scaling coefficients + snapshots the frozen variance
+        (lamb.py:166-181); zoadam re-zeros the error-feedback buffers
+        (zoadam.py:308-315 reinitial_error_buffer); adam needs nothing."""
+        self._onebit_froze = True
+        if self._onebit_kind == "adam":
+            return
+        if self._onebit_kind == "lamb":
+            from ..ops.onebit_lamb import on_freeze
+
+            fn = jax.jit(partial(on_freeze, cfg=self._onebit_cfg),
+                         out_shardings=self._onebit_opt_shardings)
+        else:
+            from ..ops.zoadam import on_freeze
+
+            fn = jax.jit(on_freeze, out_shardings=self._onebit_opt_shardings)
+        self.state["opt"] = fn(self.state["opt"])
 
     def _train_batch_onebit_account(self, metrics):
         """Advance the host-side mirror of the optimizer-step clock.
 
-        While still warm the overflow scalar is fetched so non-finite steps
-        (whose device-side state['step'] freezes) don't advance the phase
-        clock — the warm→frozen boundary lands exactly where the reference's
-        optimizer-step counter puts it. Once frozen the phase is monotone
-        (the clock only grows), so the per-step fetch is dropped and steps
-        chain asynchronously again — the fetch would decide nothing."""
+        While the phase can still change the overflow scalar is fetched so
+        non-finite steps (whose device-side state['step'] freezes) don't
+        advance the phase clock — boundaries land exactly where the
+        reference's optimizer-step counters put them. For adam/lamb the
+        frozen phase is monotone, so the per-step fetch is dropped there and
+        steps chain asynchronously again; zoadam's interval grid needs the
+        exact clock forever, so it always fetches."""
+        if self._onebit_kind == "zoadam":
+            if not bool(np.asarray(jax.device_get(metrics["overflow"]))):
+                self._onebit_applied_steps += 1
+                self._zo_clock.advance()
+            return
         if self._onebit_applied_steps > self._onebit_cfg.freeze_step:
             self._onebit_applied_steps += 1  # phase can never flip back
             return
@@ -892,6 +1084,8 @@ class DeepSpeedEngine:
 
     def _build_train_step(self, grads_only: bool = False):
         if self._onebit_cfg is not None:
+            if self._onebit_kind == "zoadam":
+                return self._build_zoadam_train_step(("warm", True))
             return self._build_onebit_train_step(frozen=False)
         cfg = self.config
         mesh = self.mesh
@@ -1628,6 +1822,18 @@ class DeepSpeedEngine:
         if self._onebit_cfg is not None:
             # host-side phase clock mirrors the device's applied-step counter
             self._onebit_applied_steps = int(jax.device_get(state["step"]))
+            if self._onebit_kind == "zoadam":
+                from ..ops.zoadam import ZeroOneClock
+
+                self._zo_clock = ZeroOneClock.replay(
+                    self._onebit_cfg, self._onebit_applied_steps
+                )
+                # transition already applied iff a frozen step has run
+                self._onebit_froze = self._zo_clock._frozen(self._onebit_applied_steps)
+            else:
+                self._onebit_froze = (
+                    self._onebit_applied_steps > self._onebit_cfg.freeze_step
+                )
         if self._nvme_offload:
             state_dir = os.path.join(load_dir, tag, "nvme_optimizer")
             loaded = self.nvme_opt.load_state(state_dir)

@@ -1,0 +1,108 @@
+"""ZeRO-Infinity NVMe *parameter* tier hardware validation (round 5).
+
+VERDICT r4 #3 / weak #5: the optimizer NVMe tier was demonstrated in r3-r4,
+but no experiment showed PARAMETERS streaming through ``csrc/aio`` during a
+real hardware train step. This runs offload_param=nvme + offload_optimizer=
+nvme: fp32 masters + Adam moments live as files (written/read via the
+native aio pthread pool), the bf16 working set stays in pinned host DRAM
+(2 bytes/param of DRAM instead of 16), and each scanned layer streams its
+slice into HBM just-in-time.
+
+Reference bar: docs/_pages/training.md:293 — ZeRO-Infinity trains 13B on a
+single V100 by spilling to NVMe.
+
+Usage: python experiments/offload_nvme_r5.py [preset] [steps]
+Presets as offload_param_r4.py: 125m | 1b3 | 2b7 | 6b7
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+from offload_param_r4 import PRESETS  # same geometry presets
+
+
+def main(preset: str = "1b3", steps: int = 4, swap_dir: str = "/tmp/dstpu_nvme_r5"):
+    L, d, H, S, B = PRESETS[preset]
+    tcfg = TransformerConfig(
+        vocab_size=50304, max_seq_len=S, num_layers=L, num_heads=H,
+        hidden_size=d, dtype=jnp.bfloat16, attn_impl="flash",
+        remat=True, remat_policy="save_flash", loss_chunk_size=512,
+    )
+    model = Model(tcfg)
+    n_params = (
+        tcfg.vocab_size * d + L * (4 * d * d + 2 * d * tcfg.ffn_size)
+        + L * 4 * d + 2 * d + S * d
+    )
+    os.makedirs(swap_dir, exist_ok=True)
+    cfg = {
+        "train_batch_size": B,
+        "train_micro_batch_size_per_gpu": B,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {
+            "stage": 1,
+            "offload_optimizer": {"device": "nvme", "nvme_path": swap_dir},
+            "offload_param": {"device": "nvme"},
+        },
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10**9,
+        "mesh": {"data": 1},
+    }
+    print(f"preset={preset}: ~{n_params/1e9:.2f}B params "
+          f"(bf16 {2*n_params/1e9:.1f} GB pinned DRAM, fp32 states "
+          f"{12*n_params/1e9:.1f} GB on NVMe at {swap_dir})")
+    t0 = time.time()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    print(f"engine+init: {time.time()-t0:.1f}s")
+    from deepspeed_tpu.ops.aio import aio_available
+
+    print(f"native aio (csrc/aio pthread pool): {aio_available()}")
+    rng = np.random.default_rng(0)
+    batch = {"tokens": rng.integers(0, 50304, size=(B, S + 1)).astype(np.int32)}
+
+    t0 = time.time()
+    m = engine.train_batch(batch)
+    loss0 = float(np.asarray(m["loss"]))
+    print(f"step 1 (compile+run): {time.time()-t0:.1f}s loss={loss0:.3f}")
+    times, loss = [], loss0
+    for i in range(steps):
+        t0 = time.time()
+        m = engine.train_batch(batch)
+        loss = float(np.asarray(m["loss"]))
+        times.append(time.time() - t0)
+        print(f"step {i+2}: {times[-1]:.2f}s loss={loss:.3f}")
+    # tier files actually on disk = the parameters' fp32 masters + moments
+    tier_bytes = sum(
+        os.path.getsize(os.path.join(r, f))
+        for r, _, fs in os.walk(swap_dir) for f in fs
+    )
+    step_s = float(np.median(times))
+    rec = {
+        "preset": preset,
+        "n_params_b": round(n_params / 1e9, 3),
+        "step_s": round(step_s, 3),
+        "tokens_per_s": round(B * S / step_s, 1),
+        "loss_first": round(loss0, 3),
+        "loss_last": round(loss, 3),
+        "nvme_tier_gb_on_disk": round(tier_bytes / 2**30, 2),
+        "swap_dir": swap_dir,
+    }
+    print(json.dumps(rec))
+    return rec
+
+
+if __name__ == "__main__":
+    args = sys.argv[1:]
+    main(args[0] if args else "1b3", int(args[1]) if len(args) > 1 else 4)

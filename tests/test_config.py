@@ -6,6 +6,7 @@ import pytest
 from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
 
 
+@pytest.mark.smoke
 def test_batch_triangulation_full():
     cfg = DeepSpeedConfig.from_dict(
         {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 2},
@@ -15,6 +16,7 @@ def test_batch_triangulation_full():
     assert cfg.gradient_accumulation_steps == 2
 
 
+@pytest.mark.smoke
 def test_batch_triangulation_infer_gas():
     cfg = DeepSpeedConfig.from_dict(
         {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4}, world_size=4
@@ -29,6 +31,7 @@ def test_batch_triangulation_infer_train():
     assert cfg.train_batch_size == 16
 
 
+@pytest.mark.smoke
 def test_batch_inconsistent_raises():
     with pytest.raises(DeepSpeedConfigError):
         DeepSpeedConfig.from_dict(

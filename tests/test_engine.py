@@ -27,6 +27,7 @@ def _make_engine(zero_stage=0, dtype=None, mesh_over=None, **cfg_over):
 
 
 @pytest.mark.parametrize("stage", [0, 1, 2, 3])
+@pytest.mark.smoke
 def test_zero_stage_trains(stage):
     engine = _make_engine(zero_stage=stage)
     batch = random_tokens(16)
@@ -58,6 +59,7 @@ def test_zero12_params_replicated_opt_sharded():
     assert "fsdp" in m_spec or "data" in m_spec
 
 
+@pytest.mark.smoke
 def test_bf16_training():
     engine = _make_engine(zero_stage=2, dtype="bf16")
     batch = random_tokens(16)
@@ -119,6 +121,7 @@ def test_compat_forward_backward_step():
     assert l1 < l0
 
 
+@pytest.mark.smoke
 def test_checkpoint_roundtrip(tmp_path):
     """save → load → bitwise state equality (reference: tests/unit/checkpoint
     compare_model_states)."""

@@ -116,6 +116,7 @@ def test_policy_for_unknown_raises():
         policy_for(FakeCfg())
 
 
+@pytest.mark.smoke
 def test_engine_forward_and_generate_consistency():
     hf = CASES["gpt2"]()
     engine = InferenceEngine(hf_model=hf, config={"dtype": "fp32"})

