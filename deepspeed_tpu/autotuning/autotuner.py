@@ -290,6 +290,10 @@ class Autotuner:
             else:
                 mc["remat"] = True
                 mc["remat_policy"] = policy
+        for k, v in overrides.items():
+            # 'model.loss_chunk_size': 256 → TransformerConfig override
+            if k.startswith("model."):
+                mc[k[len("model."):]] = v
         return {
             "model_cfg": mc,
             "ds_config": self._apply_overrides(overrides),

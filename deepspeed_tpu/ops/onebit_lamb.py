@@ -50,6 +50,15 @@ class OneBitLambConfig:
     factor_min: float = 0.5
     factor_threshold: float = 0.1
 
+    def __post_init__(self):
+        if self.freeze_step < 1:
+            raise ValueError(
+                "OneBitLamb freeze_step must be >= 1: the frozen stage's "
+                "scaling coefficients are computed from the WARMUP momentum "
+                "(lamb.py:166-181); with no warmup steps the momentum is all "
+                "zero and every coefficient degenerates to 0 (NaN momenta on "
+                "the first compressed sync)")
+
     @classmethod
     def from_params(cls, p: dict) -> "OneBitLambConfig":
         return cls(
@@ -93,7 +102,7 @@ def on_freeze(opt, cfg: OneBitLambConfig):
         jnp.linalg.norm(m) / jnp.sqrt(float(m.size)) for m in jax.tree.leaves(opt["m"])
     ]
     united = sum(rms) / len(rms)
-    flat, treedef = jax.tree.flatten(opt["m"])
+    treedef = jax.tree.structure(opt["m"])
     coeffs = jax.tree.unflatten(
         treedef, [united / jnp.maximum(r, 1e-16) for r in rms]
     )

@@ -42,6 +42,26 @@ def _build(cls, d: dict):
 
 
 @dataclass
+class DebugConfig:
+    """Numerics / memory sanitizers (SURVEY §5 race-detection row; reference
+    analogues: torch anomaly detection + DS's overflow tracing).
+
+    - ``nan_check``: enables ``jax_debug_nans`` — every primitive result is
+      re-checked and the FIRST NaN/Inf-producing op raises with its source
+      location, instead of a NaN surfacing steps later in the loss. State
+      donation is disabled in this mode (re-execution for localisation needs
+      the inputs alive). Debug-only: each op syncs.
+    - ``donation_check``: after the first compiled step, verify the donated
+      state buffers were actually consumed (aliased into the new state) —
+      a silent donation fallback (e.g. a sharding/layout mismatch) doubles
+      resident state memory without any error.
+    """
+
+    nan_check: bool = False
+    donation_check: bool = False
+
+
+@dataclass
 class FP16Config:
     """reference: runtime/config.py fp16 block + fp16/loss_scaler.py."""
 
@@ -306,6 +326,7 @@ class DeepSpeedConfig:
     mesh: MeshAxesConfig = field(default_factory=MeshAxesConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
+    debug: DebugConfig = field(default_factory=DebugConfig)
 
     raw: dict = field(default_factory=dict, repr=False)
 
@@ -349,6 +370,7 @@ class DeepSpeedConfig:
             mesh=_build(MeshAxesConfig, _sub(d, C.MESH)),
             checkpoint=_build(CheckpointConfig, _sub(d, C.CHECKPOINT)),
             elasticity=_build(ElasticityConfig, _sub(d, C.ELASTICITY)),
+            debug=_build(DebugConfig, _sub(d, "debug")),
             raw=d,
         )
         cfg._triangulate_batch(world_size)

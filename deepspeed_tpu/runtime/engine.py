@@ -121,6 +121,11 @@ class DeepSpeedEngine:
             if isinstance(config, DeepSpeedConfig)
             else DeepSpeedConfig.from_dict(raw, world_size=dp_world)
         )
+        if self.config.debug.nan_check:
+            # first NaN-producing primitive raises with its source location
+            jax.config.update("jax_debug_nans", True)
+            log_dist("debug.nan_check: jax_debug_nans enabled (state donation "
+                     "off; every op syncs — debug runs only)", ranks=[0])
         self.model = model
         if hasattr(model, "set_mesh"):
             model.set_mesh(self.mesh)
@@ -882,6 +887,14 @@ class DeepSpeedEngine:
         dp_axes = ("data", "fsdp")
         fp16 = cfg.fp16
         kind, _on_grid = phase
+        if cfg.gradient_clipping > 0 and not getattr(self, "_onebit_clip_warned", False):
+            self._onebit_clip_warned = True
+            log_dist(
+                "zerooneadam: gradient_clipping is not applied (local steps "
+                "never materialize a global gradient to clip; the sign "
+                "compression bounds sync-step update magnitude)",
+                ranks=[0],
+            )
 
         P = PartitionSpec
         rep = lambda tree: jax.tree.map(lambda _: P(), tree)
@@ -1273,6 +1286,10 @@ class DeepSpeedEngine:
             in_shardings=(self._state_shardings, NamedSharding(self.mesh, batch_spec)),
             donate_argnums=(0,),
         )
+        if self.config.debug.nan_check:
+            # jax_debug_nans re-executes the failing op to localise it — the
+            # donated inputs must stay alive for that
+            kwargs.pop("donate_argnums")
         mixes_spaces = (
             getattr(getattr(self.model, "config", None), "remat_offload", False)
             or self.offload_param_enabled
@@ -1360,7 +1377,37 @@ class DeepSpeedEngine:
             if shapes != self._last_batch_shapes:
                 self._last_batch_shapes = shapes
                 self._check_output_shardings = True
+        donation_probe = None
+        if self.config.debug.donation_check and not getattr(self, "_donation_checked", False):
+            # snapshot the big state leaves so we can verify the compiled
+            # step actually consumed (aliased) the donated buffers
+            donation_probe = [
+                ("/".join(map(str, path)), leaf)
+                for sub in ("params", "opt", "master")
+                if sub in self.state
+                for path, leaf in jax.tree_util.tree_flatten_with_path(self.state[sub])[0]
+            ]
         self.state, metrics = self._train_step(self.state, batch)
+        if donation_probe is not None:
+            self._donation_checked = True
+            if self.config.debug.nan_check:
+                log_dist(
+                    "debug.donation_check: skipped — nan_check disables state "
+                    "donation (buffers must stay alive for NaN localisation)",
+                    ranks=[0])
+            else:
+                live = [name for name, leaf in donation_probe if not leaf.is_deleted()]
+                if live:
+                    logger.warning(
+                        "debug.donation_check: %d/%d donated state buffers were "
+                        "NOT consumed by the compiled step (first: %s) — donation "
+                        "fell back and resident state memory is doubled",
+                        len(live), len(donation_probe), live[0])
+                else:
+                    log_dist(
+                        f"debug.donation_check: all {len(donation_probe)} donated "
+                        "state buffers consumed (aliased) by the compiled step",
+                        ranks=[0])
         if self._onebit_cfg is not None:
             self._train_batch_onebit_account(metrics)
         if self._check_output_shardings:

@@ -13,7 +13,6 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.checkpoint.saver import (
@@ -47,7 +46,6 @@ def _batch():
     return {"tokens": np.random.default_rng(0).integers(0, 128, size=(8, 33)).astype(np.int32)}
 
 
-@pytest.mark.smoke
 def test_sharded_files_written(tmp_path):
     e = _engine({"data": 2, "fsdp": 4}, zero_stage=3)
     e.train_batch(_batch())

@@ -55,6 +55,7 @@ def test_top2_gating():
     np.testing.assert_allclose(np.asarray(combine.sum(axis=(1, 2))), np.ones(32), rtol=1e-5)
 
 
+@pytest.mark.smoke
 def test_dispatch_combine_identity_experts():
     """With identity experts and ample capacity, top-1 MoE ≈ gate1·x."""
     rng = jax.random.PRNGKey(2)
@@ -66,7 +67,6 @@ def test_dispatch_combine_identity_experts():
     np.testing.assert_allclose(np.asarray(out), np.asarray(g1 * x), rtol=1e-5)
 
 
-@pytest.mark.smoke
 def test_moe_transformer_trains(mesh8):
     model = tiny_transformer(moe_every=2, num_experts=8, moe_top_k=2)
     cfg = base_config()
