@@ -80,6 +80,13 @@ def main() -> int:
         status = GREEN_OK if v else YELLOW_NO
         print(f"{k:20s} {status}  {v}")
     print("-" * 60)
+    try:
+        from .ops.op_builder import report as op_report
+
+        print(op_report())
+    except Exception as e:  # a diagnostic tool must say when it can't diagnose
+        print(f"ops section unavailable: {type(e).__name__}: {e}")
+    print("-" * 60)
     return 0
 
 

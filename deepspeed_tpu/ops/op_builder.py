@@ -1,0 +1,201 @@
+"""Op-builder registry — the reference's ``op_builder/`` surface, TPU-native.
+
+Reference: ``op_builder/builder.py:105`` (OpBuilder: sources, is_compatible,
+jit_load) + ``op_builder/__init__.py`` (ALL_OPS, ``DS_BUILD_<OP>`` env
+gating): each CUDA op carries a builder that can compile it JIT or report
+why it can't.
+
+TPU inversion: most "native ops" are XLA/Pallas programs that need no build
+step at all — their builders probe that the facility exists (Pallas import,
+``compute_on('device_host')``) and ``load()`` returns the implementing
+module. The one genuinely native op (``csrc/aio`` — the ZeRO-Infinity disk
+engine) builds JIT with a single ``g++`` invocation, cached as
+``build/libdstpu_aio.so`` (ops/aio.py owns the compile line). The
+``DS_BUILD_<OP>=0`` convention is honored: a disabled op reports
+incompatible without probing, exactly like the reference's skip-build flags.
+
+Surface:
+    ALL_OPS["async_io"].is_compatible() -> (bool, reason)
+    ALL_OPS["async_io"].load()          -> implementing module
+    report()                            -> printable compatibility table
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+from typing import Optional
+
+
+class OpBuilder:
+    """One op's availability probe + loader. Subclasses set NAME and
+    override ``_probe`` (return (ok, reason)) and ``_load``."""
+
+    NAME = "base"
+    # XLA/Pallas ops need no native build; aio flips this
+    NATIVE_BUILD = False
+
+    def env_enabled(self) -> bool:
+        """``DS_BUILD_<NAME>=0`` disables the op (reference convention)."""
+        return os.environ.get(f"DS_BUILD_{self.NAME.upper()}", "1") != "0"
+
+    def is_compatible(self) -> tuple[bool, str]:
+        if not self.env_enabled():
+            return False, f"disabled via DS_BUILD_{self.NAME.upper()}=0"
+        try:
+            return self._probe()
+        except Exception as e:  # noqa: BLE001 — a probe must never raise
+            return False, f"{type(e).__name__}: {str(e)[:120]}"
+
+    def load(self):
+        """Return the module implementing the op (building JIT if native).
+        Raises RuntimeError with the incompatibility reason otherwise."""
+        ok, reason = self.is_compatible()
+        if not ok:
+            raise RuntimeError(f"op {self.NAME!r} unavailable: {reason}")
+        return self._load()
+
+    # -- subclass hooks -------------------------------------------------
+    def _probe(self) -> tuple[bool, str]:
+        return True, "ok"
+
+    def _load(self):
+        raise NotImplementedError
+
+    def _import(self, mod: str):
+        return importlib.import_module(mod, package=None)
+
+
+class AsyncIOBuilder(OpBuilder):
+    """csrc/aio/dstpu_aio.cpp — pthread-pool pread/pwrite engine (reference
+    op_builder/async_io.py + csrc/aio). The only op with a real native
+    build; ops/aio.py compiles and caches it on first use."""
+
+    NAME = "async_io"
+    NATIVE_BUILD = True
+
+    def _probe(self):
+        from . import aio, native
+
+        if native.aio_available():  # the one shared probe (env_report uses it too)
+            return True, "built (build/libdstpu_aio.so)"
+        return False, f"build failed: {aio.build_error() or 'g++ unavailable?'}"
+
+    def _load(self):
+        from . import aio
+
+        return aio
+
+
+class CPUAdamBuilder(OpBuilder):
+    """Host-tier Adam (reference csrc/adam/cpu_adam.cpp): on TPU the host
+    optimizer is a ``compute_on('device_host')`` region in the compiled
+    step — the probe is for that facility, not an AVX kernel."""
+
+    NAME = "cpu_adam"
+
+    def _probe(self):
+        from . import native
+
+        if native.cpu_adam_available():  # shared probe with env_report
+            return True, "compute_on('device_host') available"
+        return False, "jax.experimental.compute_on unavailable"
+
+    def _load(self):
+        return self._import("deepspeed_tpu.ops.optimizers")
+
+
+class CPUAdagradBuilder(CPUAdamBuilder):
+    NAME = "cpu_adagrad"
+
+
+class FusedAdamBuilder(OpBuilder):
+    """reference op_builder/fused_adam.py — on TPU 'fused' is what XLA does
+    to the jitted update; load returns the optimizer module."""
+
+    NAME = "fused_adam"
+
+    def _load(self):
+        return self._import("deepspeed_tpu.ops.optimizers")
+
+
+class FusedLambBuilder(FusedAdamBuilder):
+    NAME = "fused_lamb"
+
+
+class QuantizerBuilder(OpBuilder):
+    """reference op_builder/quantizer.py (csrc/quantization kernels) —
+    grouped sym/asym quantize as XLA reductions (ops/quantization.py)."""
+
+    NAME = "quantizer"
+
+    def _load(self):
+        return self._import("deepspeed_tpu.ops.quantization")
+
+
+class _PallasBuilder(OpBuilder):
+    def _probe(self):
+        import jax.experimental.pallas  # noqa: F401
+
+        return True, "pallas importable"
+
+
+class TransformerBuilder(_PallasBuilder):
+    """reference op_builder/transformer.py (training kernels) — Pallas
+    flash attention + the public transformer layer API."""
+
+    NAME = "transformer"
+
+    def _load(self):
+        return self._import("deepspeed_tpu.ops.pallas.flash_attention")
+
+
+class InferenceBuilder(_PallasBuilder):
+    """reference op_builder/transformer_inference — Pallas decode-attention
+    kernel + fused generate."""
+
+    NAME = "transformer_inference"
+
+    def _load(self):
+        return self._import("deepspeed_tpu.ops.pallas.decode_attention")
+
+
+class SparseAttnBuilder(_PallasBuilder):
+    """reference op_builder/sparse_attn.py — Pallas block-sparse kernels."""
+
+    NAME = "sparse_attn"
+
+    def _load(self):
+        return self._import("deepspeed_tpu.ops.sparse_attention")
+
+
+class UtilsBuilder(OpBuilder):
+    NAME = "utils"
+
+    def _load(self):
+        return self._import("deepspeed_tpu.utils.flatten")
+
+
+ALL_OPS: dict[str, OpBuilder] = {
+    b.NAME: b
+    for b in (
+        AsyncIOBuilder(), CPUAdamBuilder(), CPUAdagradBuilder(),
+        FusedAdamBuilder(), FusedLambBuilder(), QuantizerBuilder(),
+        TransformerBuilder(), InferenceBuilder(), SparseAttnBuilder(),
+        UtilsBuilder(),
+    )
+}
+
+
+def get_builder(name: str) -> Optional[OpBuilder]:
+    return ALL_OPS.get(name)
+
+
+def report() -> str:
+    """Compatibility table (the ds_report op section)."""
+    lines = [f"{'op name':24s} {'compatible':10s} reason"]
+    for name, b in sorted(ALL_OPS.items()):
+        ok, reason = b.is_compatible()
+        native = " [native]" if b.NATIVE_BUILD else ""
+        lines.append(f"{name:24s} {'YES' if ok else 'NO':10s} {reason}{native}")
+    return "\n".join(lines)

@@ -83,3 +83,35 @@ def test_instrument_decorator_passthrough():
 def test_see_memory_usage_returns_numbers():
     stats = see_memory_usage("unit-test", force=True)
     assert stats["host_rss_gb"] > 0
+
+
+def test_op_builder_registry(monkeypatch):
+    """op_builder registry (reference op_builder/__init__.py ALL_OPS +
+    builder.is_compatible + DS_BUILD_<OP> gating)."""
+    from deepspeed_tpu.ops.op_builder import ALL_OPS, get_builder, report
+
+    monkeypatch.delenv("DS_BUILD_QUANTIZER", raising=False)
+
+    assert {"async_io", "cpu_adam", "fused_adam", "fused_lamb", "quantizer",
+            "transformer", "transformer_inference", "sparse_attn",
+            "utils"} <= set(ALL_OPS)
+    # every probe answers without raising; XLA/Pallas ops are compatible here
+    for name, b in ALL_OPS.items():
+        ok, reason = b.is_compatible()
+        assert isinstance(ok, bool) and isinstance(reason, str)
+    ok, _ = ALL_OPS["quantizer"].is_compatible()
+    assert ok
+    mod = ALL_OPS["quantizer"].load()
+    assert hasattr(mod, "quantize")
+    # DS_BUILD_<OP>=0 disables (reference skip-build convention)
+    monkeypatch.setenv("DS_BUILD_QUANTIZER", "0")
+    ok, reason = ALL_OPS["quantizer"].is_compatible()
+    assert not ok and "DS_BUILD_QUANTIZER" in reason
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="unavailable"):
+        ALL_OPS["quantizer"].load()
+    monkeypatch.delenv("DS_BUILD_QUANTIZER")
+    assert get_builder("nonexistent") is None
+    txt = report()
+    assert "async_io" in txt and "sparse_attn" in txt
