@@ -33,11 +33,22 @@ def main():
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--zero-stage", type=int, default=1)
+    ap.add_argument("--optimizer", default="AdamW",
+                    choices=["AdamW", "Adam", "Lamb",
+                             "OneBitAdam", "OneBitLamb", "ZeroOneAdam"],
+                    help="1-bit family = error-feedback compressed comm "
+                         "(docs/config.md 'Optimizer')")
     ap.add_argument("--ckpt-dir", default="/tmp/dstpu_example_ckpt")
     ap.add_argument("--resume", action="store_true")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
+    is_onebit = args.optimizer in ("OneBitAdam", "OneBitLamb", "ZeroOneAdam")
+    zero_stage = args.zero_stage
+    if is_onebit and zero_stage > 1:
+        print(f"{args.optimizer} needs replicated momenta: zero stage "
+              f"{zero_stage} -> 1")
+        zero_stage = 1
     model = Model(TransformerConfig(
         vocab_size=args.vocab, max_seq_len=args.seq, num_layers=args.layers,
         num_heads=args.heads, hidden_size=args.hidden,
@@ -52,12 +63,19 @@ def main():
         "train_batch_size": args.batch,
         "train_micro_batch_size_per_gpu": args.batch // (gas * world),
         "gradient_accumulation_steps": gas,
-        "optimizer": {"type": "AdamW",
-                      "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "optimizer": {"type": args.optimizer,
+                      "params": {"lr": 3e-4, "weight_decay": 0.1,
+                                 # 1-bit family: dense warmup length before
+                                 # compressed communication kicks in
+                                 **({("var_freeze_step"
+                                      if args.optimizer == "ZeroOneAdam"
+                                      else "freeze_step"):
+                                     max(2, args.steps // 4)}
+                                    if is_onebit else {})}},
         "scheduler": {"type": "WarmupLR",
                       "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 3e-4,
                                  "warmup_num_steps": 10}},
-        "zero_optimization": {"stage": args.zero_stage},
+        "zero_optimization": {"stage": zero_stage},
         "bf16": {"enabled": on_tpu},
         "gradient_clipping": 1.0,
         "activation_checkpointing": {"enabled": True},
