@@ -49,6 +49,14 @@ def main():
         print(f"{args.optimizer} needs replicated momenta: zero stage "
               f"{zero_stage} -> 1")
         zero_stage = 1
+    opt_params = {"lr": 3e-4, "weight_decay": 0.1}
+    if is_onebit:
+        # dense warmup length before compressed communication kicks in.
+        # A CONSTANT (not derived from --steps): the freeze boundary is part
+        # of the optimizer's identity across checkpoint resume — resuming
+        # with a different --steps must not move it.
+        key = "var_freeze_step" if args.optimizer == "ZeroOneAdam" else "freeze_step"
+        opt_params[key] = 10
     model = Model(TransformerConfig(
         vocab_size=args.vocab, max_seq_len=args.seq, num_layers=args.layers,
         num_heads=args.heads, hidden_size=args.hidden,
@@ -63,15 +71,7 @@ def main():
         "train_batch_size": args.batch,
         "train_micro_batch_size_per_gpu": args.batch // (gas * world),
         "gradient_accumulation_steps": gas,
-        "optimizer": {"type": args.optimizer,
-                      "params": {"lr": 3e-4, "weight_decay": 0.1,
-                                 # 1-bit family: dense warmup length before
-                                 # compressed communication kicks in
-                                 **({("var_freeze_step"
-                                      if args.optimizer == "ZeroOneAdam"
-                                      else "freeze_step"):
-                                     max(2, args.steps // 4)}
-                                    if is_onebit else {})}},
+        "optimizer": {"type": args.optimizer, "params": opt_params},
         "scheduler": {"type": "WarmupLR",
                       "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 3e-4,
                                  "warmup_num_steps": 10}},

@@ -307,3 +307,31 @@ def test_zoadam_local_step_has_no_gradient_comm(mesh8):
     assert wire_sync.get("all-gather", 0) > 0
     assert wire_sync.get("all-gather", 0) <= 8 * (n_params // 8 + 64 * len(
         jax.tree.leaves(e.state["params"]))), wire_sync
+
+
+def test_onebit_lamb_checkpoint_resume_keeps_freeze_artifacts(tmp_path):
+    """Resuming a frozen-stage OneBitLamb run must restore the warmup-derived
+    scaling_coeff / lamb_coeff_freeze / v_fresh from the checkpoint and NOT
+    re-run the freeze hook (which would recompute coefficients from the
+    now-compressed momentum — reference keeps them in optimizer state)."""
+    cfg = _cfg("OneBitLamb", {"lr": 1e-3, "freeze_step": 2})
+    e, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=cfg)
+    b = _batch()
+    for _ in range(5):
+        e.train_batch(b)
+    assert e._onebit_froze
+    coeffs = np.array([float(c) for c in jax.tree.leaves(
+        jax.device_get(e.state["opt"]["scaling_coeff"]))])
+    e.save_checkpoint(str(tmp_path))
+    e2, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=cfg)
+    e2.load_checkpoint(str(tmp_path))
+    assert e2._onebit_froze  # already past the boundary: hook must not re-run
+    coeffs2 = np.array([float(c) for c in jax.tree.leaves(
+        jax.device_get(e2.state["opt"]["scaling_coeff"]))])
+    np.testing.assert_array_equal(coeffs, coeffs2)
+    l = float(jax.device_get(e2.train_batch(b)["loss"]))
+    assert np.isfinite(l)
+    np.testing.assert_array_equal(
+        coeffs,
+        np.array([float(c) for c in jax.tree.leaves(
+            jax.device_get(e2.state["opt"]["scaling_coeff"]))]))
