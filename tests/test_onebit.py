@@ -335,3 +335,56 @@ def test_onebit_lamb_checkpoint_resume_keeps_freeze_artifacts(tmp_path):
         coeffs,
         np.array([float(c) for c in jax.tree.leaves(
             jax.device_get(e2.state["opt"]["scaling_coeff"]))]))
+
+
+def test_compressed_allreduce_2phase_matches_reference_scheme(mesh8):
+    """Two-phase worker/server compressed allreduce (reference
+    nccl.py:51-140): constant ~2·n/8 bytes per rank on the wire, double
+    error feedback, and averaging semantics that converge to the true mean
+    as errors are fed back."""
+    from deepspeed_tpu.comm.compressed import compressed_allreduce_2phase
+
+    n, world = 4096, 8
+    rng = np.random.default_rng(0)
+    vals = rng.standard_normal((world, n)).astype(np.float32)
+    t = jnp.asarray(vals)
+    we = jnp.zeros((world, n), jnp.float32)
+    se = jnp.zeros((world, n // world), jnp.float32)
+    step = jax.jit(lambda t, we, se: compressed_allreduce_2phase(
+        t, we, se, mesh=mesh8))  # one trace; the loop reuses the executable
+    avg, we, se = step(t, we, se)
+    true_mean = vals.mean(axis=0)
+    # single shot is a coarse (sign+scale)^2 estimate — just sanity-bound it
+    assert np.corrcoef(np.asarray(avg), true_mean)[0, 1] > 0.3
+    # error feedback: repeating on a CONSTANT input converges the running
+    # average of transmitted values toward the true mean (1-bit contract)
+    est = np.asarray(avg).copy()
+    for i in range(1, 48):
+        avg, we, se = step(t, we, se)
+        est += (np.asarray(avg) - est) / (i + 1)
+    resid = np.abs(est - true_mean).mean() / np.abs(true_mean).mean()
+    assert resid < 0.35, resid
+    # wire audit at the TRACE level (XLA:CPU emulates small all-to-alls via
+    # all-reduce, hiding the payload dtype in backend HLO; the jaxpr records
+    # what actually travels): both phases ship uint8, n/8 bytes per rank
+    jaxpr = jax.make_jaxpr(lambda t, we, se: compressed_allreduce_2phase(
+        t, we, se, mesh=mesh8))(t, we, se)
+    prims = {}
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name in ("all_to_all", "all_gather"):
+                prims.setdefault(name, []).append(eqn.invars[0].aval)
+            for v in eqn.params.values():
+                if hasattr(v, "eqns"):  # plain Jaxpr (e.g. shard_map body)
+                    walk(v)
+                elif hasattr(v, "jaxpr"):  # ClosedJaxpr
+                    walk(v.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    a2a = prims.get("all_to_all", [])
+    assert a2a and all(a.dtype == jnp.uint8 for a in a2a), prims
+    assert sum(int(np.prod(a.shape)) for a in a2a) == n // 8  # packed phase 1
+    ag_u8 = [a for a in prims.get("all_gather", []) if a.dtype == jnp.uint8]
+    assert ag_u8 and sum(int(np.prod(a.shape)) for a in ag_u8) == n // world // 8
