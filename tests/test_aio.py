@@ -112,6 +112,6 @@ def test_tensor_swapper_roundtrip(tmp_path):
     back = sw.swap_in(man)
     np.testing.assert_array_equal(back["m"]["w"], tree["m"]["w"])
     np.testing.assert_array_equal(back["v"]["w"], tree["v"]["w"])
-    assert int(back["step"]) == 7
+    assert int(np.asarray(back["step"]).item()) == 7
     sw.release(man)
     sw.close()
