@@ -65,11 +65,9 @@ def _preflight():
 def main():
     import jax
 
-    # env JAX_PLATFORMS alone is not honored when a site plugin hooks backend
-    # init (observed with the axon TPU plugin) — config.update is
-    plat_env = os.environ.get("JAX_PLATFORMS")
-    if plat_env:
-        jax.config.update("jax_platforms", plat_env)
+    from deepspeed_tpu.utils.jax_env import apply_platform_env
+
+    apply_platform_env()  # env alone is not honored under the axon site hook
 
     if os.environ.get(_MODE_ENV) == "preflight":
         _preflight()

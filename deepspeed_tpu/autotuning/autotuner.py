@@ -87,12 +87,20 @@ class Autotuner:
         batch_factory: Callable[[], dict],
         steps: int = 5,
         warmup: int = 2,
+        world_size: Optional[int] = None,
+        hbm_gb: Optional[float] = None,
     ):
+        """``world_size``/``hbm_gb``: supply both to keep the tuner from
+        touching ``jax.devices()`` at all — REQUIRED when driving isolated
+        subprocess trials on an accelerator (a parent that initializes the
+        backend holds the device lock and every child trial dies at init)."""
         self.model_factory = model_factory
         self.base_config = dict(base_config)
         self.batch_factory = batch_factory
         self.steps = steps
         self.warmup = warmup
+        self.world_size = world_size
+        self.hbm_gb = hbm_gb
 
     # -- candidate enumeration ---------------------------------------------
     def _expand(self, space: dict) -> list[dict]:
@@ -124,7 +132,7 @@ class Autotuner:
         """data x fsdp product with any single -1 wildcard axis resolved the
         way MeshConfig.sizes does (remaining devices)."""
         mesh = cfg.get("mesh", {})
-        n = len(jax.devices())
+        n = self.world_size if self.world_size is not None else len(jax.devices())
         sizes = {k: mesh.get(k, -1 if k == "data" else 1)
                  for k in ("pipe", "data", "fsdp", "context", "model")}
         unknown = [k for k, v in sizes.items() if v == -1]
@@ -146,6 +154,8 @@ class Autotuner:
         return self._mc_cache[key]
 
     def _device_mem_gb(self) -> float:
+        if self.hbm_gb is not None:
+            return self.hbm_gb
         stats = getattr(jax.local_devices()[0], "memory_stats", lambda: None)() or {}
         limit = stats.get("bytes_limit", 0)
         return limit / 1e9 if limit else 16.0  # v5e-class default
@@ -191,7 +201,8 @@ class Autotuner:
         policy = overrides.get("remat_policy", "save_flash")
         rank += {"none": 0.0, "dots_and_flash": 0.5, "save_flash": 1.0}.get(policy, 1.5)
         rank += overrides.get("micro_batch_divisor", 1) * 0.25
-        if len(jax.devices()) > 1:
+        n_dev = self.world_size if self.world_size is not None else len(jax.devices())
+        if n_dev > 1:
             rank += {1: 0.0, 2: 0.1, 3: 0.3, 0: 0.0}.get(overrides.get("zero_stage", 1), 0)
         try:
             est = self._estimate_mem_gb(overrides)

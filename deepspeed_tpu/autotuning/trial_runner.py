@@ -22,10 +22,9 @@ def main(spec_path: str) -> int:
 
     import jax
 
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        # env alone is not honored when a site plugin hooks backend init
-        jax.config.update("jax_platforms", plat)
+    from deepspeed_tpu.utils.jax_env import apply_platform_env
+
+    apply_platform_env()  # env alone is not honored under the axon site hook
     import jax.numpy as jnp
     import numpy as np
 

@@ -1,0 +1,54 @@
+"""JAX platform/env plumbing shared by every CLI entry point.
+
+The axon TPU site plugin hooks backend initialization, and under it the
+``JAX_PLATFORMS`` environment variable ALONE is not honored — a process that
+sets ``JAX_PLATFORMS=cpu`` still dials the TPU tunnel (and hangs forever if
+it is down). ``jax.config.update("jax_platforms", ...)`` is; every entry
+point (bench.py, autotuning/trial_runner.py, bin/dstpu_bench, tests
+conftest) must apply it before the first backend use.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+
+def apply_platform_env() -> None:
+    """Honor ``JAX_PLATFORMS`` even when a site plugin hooks backend init.
+    Call before any jax device use; a no-op when the variable is unset."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+
+def probe_backend(timeout: float = 120.0) -> dict:
+    """Discover the backend WITHOUT initializing it in this process.
+
+    Runs ``jax.default_backend()`` in a subprocess so the caller never takes
+    the accelerator lock — essential for launchers that will spawn per-trial
+    subprocesses needing the device (a parent holding the TPU makes every
+    child fail at backend init). Returns {'backend': str, 'n_devices': int}
+    or {'error': str} on timeout/failure (e.g. the tunnel is down)."""
+    code = (
+        "import os, json\n"
+        "import jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "print(json.dumps({'backend': jax.default_backend(),"
+        " 'n_devices': jax.device_count()}))\n"
+    )
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                              text=True, timeout=timeout)
+        for line in reversed(proc.stdout.splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+        return {"error": f"rc={proc.returncode}: {(proc.stderr or '')[-200:]}"}
+    except subprocess.TimeoutExpired:
+        return {"error": f"backend probe timed out after {timeout}s "
+                         "(accelerator tunnel down?)"}
