@@ -56,7 +56,11 @@ SPACE = {
 def main(max_trials: int = 12, trial_timeout: float = 900.0):
     exp_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "autotune_r5_log")
-    tuner = Autotuner(lambda ov: None, BASE, lambda: None, steps=10, warmup=2)
+    # world_size/hbm_gb given explicitly: the parent must NOT touch
+    # jax.devices() — it would take the single chip's lock and every
+    # subprocess trial would die at backend init
+    tuner = Autotuner(lambda ov: None, BASE, lambda: None, steps=10, warmup=2,
+                      world_size=1, hbm_gb=16.0)
     sched = ExperimentScheduler(exp_dir, trial_timeout=trial_timeout)
     res = tuner.tune_isolated(
         MODEL_CFG, {"size": B, "seq": S, "vocab": V}, sched,

@@ -337,6 +337,7 @@ def test_onebit_lamb_checkpoint_resume_keeps_freeze_artifacts(tmp_path):
             jax.device_get(e2.state["opt"]["scaling_coeff"]))]))
 
 
+@pytest.mark.smoke
 def test_compressed_allreduce_2phase_matches_reference_scheme(mesh8):
     """Two-phase worker/server compressed allreduce (reference
     nccl.py:51-140): constant ~2·n/8 bytes per rank on the wire, double
