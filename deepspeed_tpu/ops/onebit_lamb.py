@@ -49,6 +49,12 @@ class OneBitLambConfig:
     factor_max: float = 4.0
     factor_min: float = 0.5
     factor_threshold: float = 0.1
+    # 'one_shot': single compression + packed all-gather ((world-1)*n/8
+    #   received per rank; one error buffer) — default on a single slice.
+    # 'two_phase': the reference backend's exact worker/server scheme
+    #   (nccl.py:51-140): all-to-all + re-compressed server chunks, ~2*n/8
+    #   per rank regardless of world size; adds the server error buffer.
+    comm_backend: str = "one_shot"
 
     def __post_init__(self):
         if self.freeze_step < 1:
@@ -58,6 +64,10 @@ class OneBitLambConfig:
                 "(lamb.py:166-181); with no warmup steps the momentum is all "
                 "zero and every coefficient degenerates to 0 (NaN momenta on "
                 "the first compressed sync)")
+        if self.comm_backend not in ("one_shot", "two_phase"):
+            raise ValueError(
+                f"comm_backend must be one_shot|two_phase, got "
+                f"{self.comm_backend!r}")
 
     @classmethod
     def from_params(cls, p: dict) -> "OneBitLambConfig":
@@ -73,25 +83,42 @@ class OneBitLambConfig:
             factor_max=float(p.get("factor_max", 4.0)),
             factor_min=float(p.get("factor_min", 0.5)),
             factor_threshold=float(p.get("factor_threshold", 0.1)),
+            comm_backend=str(p.get("comm_backend", "one_shot")),
         )
 
 
-def init_state(params, dp: int):
+def _padded_size(n_total: int, dp: int) -> int:
+    """Flat fused-buffer size padded so every rank's server chunk packs to
+    whole bytes (the reference pads exp_avg_flat to its corrected size the
+    same way, lamb.py:268-276)."""
+    q = dp * 8
+    return n_total + (-n_total) % q
+
+
+def init_state(params, dp: int, cfg: OneBitLambConfig = None):
     """m/v/v_fresh and the per-tensor scalars replicated; ONE flat
     error-feedback buffer with a [dp] leading axis (the reference's fused
-    ``exp_avg_flat`` + ``worker_errors`` layout, lamb.py:259-295)."""
+    ``exp_avg_flat`` + ``worker_errors`` layout, lamb.py:259-295). Under
+    ``comm_backend='two_phase'`` the flat buffer is padded to pack every
+    rank's server chunk into whole bytes, and the per-rank SERVER error
+    buffer (lamb.py ``server_errors``) joins the state."""
     zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
     scalars = lambda v: jax.tree.map(lambda _: jnp.asarray(v, jnp.float32), params)
     n_total = sum(p.size for p in jax.tree.leaves(params))
-    return {
+    two_phase = cfg is not None and cfg.comm_backend == "two_phase"
+    n_flat = _padded_size(n_total, dp) if two_phase else n_total
+    state = {
         "m": jax.tree.map(zeros, params),
         "v": jax.tree.map(zeros, params),
         "v_fresh": jax.tree.map(zeros, params),
-        "error": {"flat": jnp.zeros((dp, n_total), jnp.float32)},
+        "error": {"flat": jnp.zeros((dp, n_flat), jnp.float32)},
         "scaling_coeff": scalars(1.0),
         "lamb_coeff_freeze": scalars(0.0),
         "last_factor": scalars(1.0),
     }
+    if two_phase:
+        state["server_error"] = {"flat": jnp.zeros((dp, n_flat // dp), jnp.float32)}
+    return state
 
 
 def on_freeze(opt, cfg: OneBitLambConfig):
@@ -109,14 +136,17 @@ def on_freeze(opt, cfg: OneBitLambConfig):
     return {**opt, "v_fresh": opt["v"], "scaling_coeff": coeffs}
 
 
-def momentum_sync(g_local, opt, cfg: OneBitLambConfig, dp_axes, frozen: bool):
+def momentum_sync(g_local, opt, cfg: OneBitLambConfig, dp_axes, frozen: bool,
+                  dp: int = 1):
     """Per-device phase (inside shard_map): returns the new opt pytree.
 
     warm:   m/v from the pmean'd gradient — baseline LAMB moments
     frozen: v untouched; each momentum is scaled by its ``scaling_coeff``,
             the whole pytree flattened, 1-bit-compressed ONCE (one scale for
             the fused buffer, like the reference's flattened allreduce),
-            averaged, unscaled.
+            averaged, unscaled. ``comm_backend='two_phase'`` routes the flat
+            buffer through the worker/server kernel instead (the reference
+            backend's exact scheme; ``dp`` = mesh world over ``dp_axes``).
     """
     b1, b2 = cfg.betas
     if not frozen:
@@ -129,13 +159,29 @@ def momentum_sync(g_local, opt, cfg: OneBitLambConfig, dp_axes, frozen: bool):
         v_new = jax.tree.map(lambda o: o[1], out, is_leaf=lambda x: isinstance(x, tuple))
         return {**opt, "m": m_new, "v": v_new}
 
-    from ..comm.compressed import compressed_allreduce_p
-
     m_loc = jax.tree.map(
         lambda g, m, c: (b1 * m + (1.0 - b1) * g) * c,
         g_local, opt["m"], opt["scaling_coeff"],
     )
     flat, unravel = ravel_pytree(m_loc)
+    if cfg.comm_backend == "two_phase":
+        from ..comm.compressed import compressed_allreduce_2phase_p
+
+        n_flat = opt["error"]["flat"].shape[-1]
+        pad = n_flat - flat.size
+        flat_p = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)]) if pad else flat
+        avg_p, err_new, serr_new = compressed_allreduce_2phase_p(
+            flat_p, opt["error"]["flat"][0], opt["server_error"]["flat"][0],
+            dp_axes, dp)
+        avg_flat = avg_p[: flat.size]
+        m_new = jax.tree.map(
+            lambda m, c: m / c, unravel(avg_flat), opt["scaling_coeff"]
+        )
+        return {**opt, "m": m_new, "error": {"flat": err_new[None]},
+                "server_error": {"flat": serr_new[None]}}
+
+    from ..comm.compressed import compressed_allreduce_p
+
     avg_flat, err_new = compressed_allreduce_p(flat, opt["error"]["flat"][0], dp_axes)
     m_new = jax.tree.map(
         lambda m, c: m / c, unravel(avg_flat), opt["scaling_coeff"]

@@ -372,14 +372,20 @@ class DeepSpeedEngine:
 
                 self.opt_specs = {"m": rep, "v": rep, "error": stacked}
             elif self._onebit_kind == "lamb":
-                from ..ops.onebit_lamb import init_state as onebit_init
+                from ..ops.onebit_lamb import init_state as _lamb_init
 
+                onebit_init = partial(_lamb_init, cfg=self._onebit_cfg)
                 self.opt_specs = {
                     "m": rep, "v": rep, "v_fresh": rep,
                     "error": {"flat": PartitionSpec(("data", "fsdp"))},
                     "scaling_coeff": rep, "lamb_coeff_freeze": rep,
                     "last_factor": rep,
                 }
+                if self._onebit_cfg.comm_backend == "two_phase":
+                    # reference backend parity: per-rank server-chunk error
+                    self.opt_specs["server_error"] = {
+                        "flat": PartitionSpec(("data", "fsdp"))
+                    }
             else:  # zoadam: per-rank momentum / delta accumulator / residual
                 from ..ops.zoadam import init_state as onebit_init
 
@@ -764,8 +770,10 @@ class DeepSpeedEngine:
         else:  # lamb
             from ..ops import onebit_lamb as obl
 
+            dp_world = data_parallel_size(mesh)
+
             def sync_fn(g, opt):
-                return obl.momentum_sync(g, opt, obc, dp_axes, frozen)
+                return obl.momentum_sync(g, opt, obc, dp_axes, frozen, dp=dp_world)
 
             def apply_fn(params, opt_prev, opt_new, step1, lr):
                 return obl.apply_update(params, opt_prev, opt_new, lr, obc, frozen)
