@@ -98,20 +98,23 @@ def main():
         pos_emb="learned",
         dtype=jnp.bfloat16,
         remat=on_tpu,  # activation checkpointing over the layer scan
-        # dstpu_bench --autotune sweep (experiments/autotune_r3.json): at
-        # micro 32 the dots_and_flash policy (no matmul recompute) fits HBM
-        # and beats save_flash@micro64 by ~7% (99.2k vs 92.8k tok/s).
-        # fallback mode: the r2-proven save_flash geometry — compiles smaller
-        # and survives even if the tuned path regresses.
+        # r5 isolated sweep (experiments/autotune_r5_log/autotune_r5.json, 18
+        # trials on chip): dots_and_flash @ micro 16 with the loss chunked at
+        # 256 beats the r3 winner (micro 32, chunk 512) 104.7k vs 99.2k tok/s
+        # — the smaller live-logit slab lets the no-matmul-recompute policy
+        # keep more of the batch resident. fallback mode: the r2-proven
+        # save_flash geometry — compiles smaller and survives even if the
+        # tuned path regresses.
         remat_policy=("save_flash" if (fallback or not on_tpu) else "dots_and_flash"),
         attn_impl="flash" if on_tpu else "xla",
         # experiments/perf_probe5.py: 1024x1024 beats the auto 512/1024 cap
         # by ~1.6% at these shapes (the whole 1k sequence in one k-block)
         flash_block_q=1024 if on_tpu else 0,
         flash_block_k=1024 if on_tpu else 0,
+        loss_chunk_size=256 if on_tpu else 0,
     )
     model = Model(cfg)
-    micro = (B // 2) if on_tpu else B
+    micro = (B // 2 if fallback else B // 4) if on_tpu else B
     ds_cfg = {
         "train_batch_size": B,
         "train_micro_batch_size_per_gpu": micro,
@@ -171,7 +174,7 @@ def main():
         "platform": platform,
         "n_chips": n_chips,
         "compile_s": round(compile_s, 1),
-        "config": "fallback_save_flash_micro32" if fallback else "tuned_dots_and_flash_micro32",
+        "config": "fallback_save_flash_micro32" if fallback else "tuned_r5_dots_and_flash_micro16_chunk256",
     }
     print(json.dumps(out), flush=True)
     sys.stdout.flush()
