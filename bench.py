@@ -111,7 +111,9 @@ def main():
         # by ~1.6% at these shapes (the whole 1k sequence in one k-block)
         flash_block_q=1024 if on_tpu else 0,
         flash_block_k=1024 if on_tpu else 0,
-        loss_chunk_size=256 if on_tpu else 0,
+        # fallback keeps the default chunk 512 — exactly the r2-proven
+        # geometry, not an untested save_flash+chunk256 combination
+        loss_chunk_size=256 if (on_tpu and not fallback) else 512,
     )
     model = Model(cfg)
     micro = (B // 2 if fallback else B // 4) if on_tpu else B

@@ -1,0 +1,98 @@
+"""Chip-time queue: run the round-5 hardware experiments whenever the
+flaky axon tunnel is actually up.
+
+Round 4 lost its TPU number to tunnel flaps; round 5's first session saw the
+tunnel down for 10+ hours. The fix is to stop treating chip access as
+always-on: this runner polls with a tiny-jit probe (fresh subprocess each
+time — JAX caches backend-init failures per process), and whenever the
+tunnel answers it drains the experiment queue in priority order, recording
+per-item status resumably in chip_queue_state.json. A mid-run tunnel drop
+becomes a recorded attempt, not a lost session.
+
+Usage: python experiments/chip_queue.py [--once]
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+STATE = os.path.join(HERE, "chip_queue_state.json")
+LOGDIR = os.path.join(HERE, "chip_queue_logs")
+
+# (name, argv, timeout_s, max_attempts)
+QUEUE = [
+    ("bench_r5", [sys.executable, os.path.join(REPO, "bench.py")], 1500, 3),
+    ("roofline_r5", [sys.executable, os.path.join(HERE, "roofline_r5.py")], 1800, 2),
+    ("offload_2b7", [sys.executable, os.path.join(HERE, "offload_param_r4.py"), "2b7"], 2400, 2),
+    ("nvme_1b3", [sys.executable, os.path.join(HERE, "offload_nvme_r5.py"), "1b3"], 2400, 2),
+    ("infer_7b_int8_b1", [sys.executable, os.path.join(REPO, "benchmarks", "inference_latency.py"),
+                          "--model", "bloom7b-class", "--int8", "--batch", "1"], 3600, 2),
+    ("infer_7b_int8_b8", [sys.executable, os.path.join(REPO, "benchmarks", "inference_latency.py"),
+                          "--model", "bloom7b-class", "--int8", "--batch", "8"], 3600, 2),
+    ("offload_6b7", [sys.executable, os.path.join(HERE, "offload_param_r4.py"), "6b7"], 3600, 2),
+    ("nvme_2b7", [sys.executable, os.path.join(HERE, "offload_nvme_r5.py"), "2b7"], 3600, 2),
+]
+
+def tunnel_up(timeout=150):
+    sys.path.insert(0, REPO)
+    from deepspeed_tpu.utils.jax_env import probe_backend
+
+    # the axon tunnel may report 'tpu' or 'axon'; anything non-cpu is live
+    info = probe_backend(timeout=timeout)
+    return info.get("backend") not in (None, "cpu")
+
+
+def load_state():
+    if os.path.exists(STATE):
+        with open(STATE) as f:
+            return json.load(f)
+    return {}
+
+
+def save_state(st):
+    with open(STATE, "w") as f:
+        json.dump(st, f, indent=1)
+
+
+def main():
+    once = "--once" in sys.argv
+    os.makedirs(LOGDIR, exist_ok=True)
+    st = load_state()
+    while True:
+        pending = [q for q in QUEUE
+                   if st.get(q[0], {}).get("status") != "ok"
+                   and st.get(q[0], {}).get("attempts", 0) < q[3]]
+        if not pending:
+            print("[queue] all items done/exhausted", flush=True)
+            return
+        if not tunnel_up():
+            print(f"[queue] tunnel down; {len(pending)} pending; sleeping 120s",
+                  flush=True)
+            if once:
+                return
+            time.sleep(120)
+            continue
+        name, argv, tmo, _ = pending[0]
+        rec = st.setdefault(name, {"attempts": 0})
+        rec["attempts"] += 1
+        print(f"[queue] running {name} (attempt {rec['attempts']})", flush=True)
+        log = os.path.join(LOGDIR, f"{name}.log")
+        t0 = time.time()
+        try:
+            with open(log, "a") as lf:
+                p = subprocess.run(argv, timeout=tmo, stdout=lf,
+                                   stderr=subprocess.STDOUT, cwd=REPO)
+            rec["status"] = "ok" if p.returncode == 0 else f"rc={p.returncode}"
+        except subprocess.TimeoutExpired:
+            rec["status"] = "timeout"
+        rec["elapsed_s"] = round(time.time() - t0, 1)
+        save_state(st)
+        print(f"[queue] {name}: {rec['status']} in {rec['elapsed_s']}s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -81,7 +81,7 @@ def main():
     # --- flash attention fwd+bwd at bench shapes ---
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
-    q = jnp.ones((MICRO, H, S, D // H), jnp.bfloat16)
+    q = jnp.ones((MICRO, S, H, D // H), jnp.bfloat16)  # kernel layout [B,S,H,Dh]
 
     def attn_step(q):
         def loss(q):
@@ -94,7 +94,7 @@ def main():
     dt = timed(f, q)
     # fwd 4*S*S*Dh MACs per head (QK^T+AV) /2 causal, bwd ~2.5x fwd
     attn_flops = MICRO * H * (2 * 2 * S * S * (D // H)) / 2 * 3.5
-    rows.append({"component": "flash_attn_fwd+bwd", "shape": [MICRO, H, S, D // H],
+    rows.append({"component": "flash_attn_fwd+bwd", "shape": [MICRO, S, H, D // H],
                  "tflops": round(attn_flops / dt / 1e12, 1), "ms": round(dt * 1e3, 3)})
 
     # --- LayerNorm fwd+bwd (the fp32 round trip) ---
