@@ -105,6 +105,14 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_coeff: float = 0.01  # load-balancing loss weight
     loss_chunk_size: int = 512  # chunk the vocab projection in the loss; 0 = off
+    # "chunked": lax.scan over sequence chunks (logits chunk materialized,
+    #   recomputed in backward — see lm_loss_from_hidden). "fused_xent":
+    #   Pallas fused projection+xent (ops/pallas/fused_xent.py) — logits
+    #   never reach HBM in either pass. Single-device / per-shard path;
+    #   vocab-sharded TP keeps "chunked" (XLA partitions the einsum).
+    loss_impl: str = "chunked"
+    loss_fused_block_rows: int = 0  # 0 = auto (fused_xent._auto_block)
+    loss_fused_block_v: int = 0
     # Dropout (reference fused layer: csrc/transformer/dropout_kernels.cu —
     # attn_output_dropout_ratio / hidden_dropout_ratio). Applied on the
     # attention output projection (attn) and on embeddings + FFN output
@@ -406,7 +414,9 @@ def _remat_policy(name: str, offload: bool = False):
             offload_src="device",
             offload_dst="pinned_host",
         )
-    flash_names = cp.save_only_these_names("flash_out", "flash_lse")
+    # xent_lse: the fused loss kernel's residual (ops/pallas/fused_xent.py) —
+    # saved so a remat region spanning the loss never re-runs its forward
+    flash_names = cp.save_only_these_names("flash_out", "flash_lse", "xent_lse")
     if name == "save_flash":
         return flash_names
     if name == "dots_and_flash":
@@ -1073,6 +1083,36 @@ def lm_loss_from_hidden(cfg: TransformerConfig, params: Params, hidden, labels,
         head = stream(params["wte"]).T
     else:
         head = stream(head)
+
+    _n_rows = hidden.shape[0] * hidden.shape[1]
+    _br = cfg.loss_fused_block_rows or 128
+    _bv = cfg.loss_fused_block_v or 128
+    _fused_fits = (_n_rows % 128 == 0 and _n_rows % _br == 0
+                   and _br % 128 == 0 and _bv % 128 == 0)
+    if cfg.loss_impl == "fused_xent" and not _fused_fits:
+        import warnings
+
+        warnings.warn(
+            f"loss_impl='fused_xent' needs rows (B*S={_n_rows}) divisible by "
+            f"128 and by loss_fused_block_rows "
+            f"({cfg.loss_fused_block_rows or 'auto'}), and 128-aligned "
+            f"block_rows/block_v; falling back to the chunked loss — the "
+            "fused kernel's HBM savings do NOT apply",
+            stacklevel=2,
+        )
+    if cfg.loss_impl == "fused_xent" and _fused_fits:
+        from ..ops.pallas.fused_xent import fused_linear_xent
+
+        B, S, D = hidden.shape
+        nll = fused_linear_xent(
+            hidden.reshape(B * S, D),
+            head.astype(hidden.dtype),
+            labels.reshape(B * S),
+            block_rows=cfg.loss_fused_block_rows or None,
+            block_v=cfg.loss_fused_block_v or None,
+        )
+        mask = (labels.reshape(B * S) >= 0).astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
     chunk = cfg.loss_chunk_size
     S = hidden.shape[1]

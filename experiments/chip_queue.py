@@ -27,6 +27,7 @@ LOGDIR = os.path.join(HERE, "chip_queue_logs")
 QUEUE = [
     ("bench_r5", [sys.executable, os.path.join(REPO, "bench.py")], 1500, 3),
     ("roofline_r5", [sys.executable, os.path.join(HERE, "roofline_r5.py")], 1800, 2),
+    ("fused_xent_r5", [sys.executable, os.path.join(HERE, "fused_xent_r5.py")], 2500, 2),
     ("offload_2b7", [sys.executable, os.path.join(HERE, "offload_param_r4.py"), "2b7"], 2400, 2),
     ("nvme_1b3", [sys.executable, os.path.join(HERE, "offload_nvme_r5.py"), "1b3"], 2400, 2),
     ("infer_7b_int8_b1", [sys.executable, os.path.join(REPO, "benchmarks", "inference_latency.py"),
@@ -37,8 +38,10 @@ QUEUE = [
     ("nvme_2b7", [sys.executable, os.path.join(HERE, "offload_nvme_r5.py"), "2b7"], 3600, 2),
 ]
 
+sys.path.insert(0, REPO)
+
+
 def tunnel_up(timeout=150):
-    sys.path.insert(0, REPO)
     from deepspeed_tpu.utils.jax_env import probe_backend
 
     # the axon tunnel may report 'tpu' or 'axon'; anything non-cpu is live
@@ -48,14 +51,19 @@ def tunnel_up(timeout=150):
 
 def load_state():
     if os.path.exists(STATE):
-        with open(STATE) as f:
-            return json.load(f)
+        try:
+            with open(STATE) as f:
+                return json.load(f)
+        except ValueError:  # truncated by a crash mid-write; start fresh
+            return {}
     return {}
 
 
 def save_state(st):
-    with open(STATE, "w") as f:
+    tmp = STATE + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(st, f, indent=1)
+    os.replace(tmp, STATE)  # atomic: a crash never truncates the state file
 
 
 def main():
@@ -79,6 +87,7 @@ def main():
         name, argv, tmo, _ = pending[0]
         rec = st.setdefault(name, {"attempts": 0})
         rec["attempts"] += 1
+        save_state(st)  # persist NOW: a runner death mid-run still counts
         print(f"[queue] running {name} (attempt {rec['attempts']})", flush=True)
         log = os.path.join(LOGDIR, f"{name}.log")
         t0 = time.time()

@@ -1,0 +1,83 @@
+"""Chip A/B for the fused projection+xent kernel (ops/pallas/fused_xent.py).
+
+Control is the autotune_r5 winner (dots_and_flash @ micro 16, chunked loss
+@ 256 -> 104.7k tok/s, experiments/autotune_r5_log/autotune_r5.json). The
+fused kernel removes the loss tail's logits HBM traffic entirely, which
+also frees the live-logit slab that capped dots_and_flash at micro 16 —
+so the sweep re-opens micro 32/64 alongside the kernel's row-block size
+(bigger row blocks re-read the 77 MB vocab matrix fewer times).
+
+6 isolated-subprocess trials, resumable log in fused_xent_r5_log/.
+
+Usage: python experiments/fused_xent_r5.py [max_trials] [trial_timeout_s]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+from deepspeed_tpu.autotuning import Autotuner, ExperimentScheduler
+
+V, S, B = 50304, 1024, 64
+
+MODEL_CFG = {
+    "vocab_size": V, "max_seq_len": S, "num_layers": 12, "num_heads": 12,
+    "hidden_size": 768, "pos_emb": "learned", "dtype": "bfloat16",
+    "attn_impl": "flash", "flash_block_q": 1024, "flash_block_k": 1024,
+    "remat": True,
+}
+
+BASE = {
+    "train_batch_size": B,
+    "optimizer": {"type": "AdamW", "params": {"lr": 6e-4, "weight_decay": 0.1}},
+    "zero_optimization": {"stage": 1},
+    "bf16": {"enabled": True},
+    "gradient_clipping": 1.0,
+    "steps_per_print": 10**9,
+    "mesh": {"data": -1},
+}
+
+# micro 64 is the stretch candidate: saved dots alone were the ~11 GB that
+# OOMed it in autotune_r5 WITH chunked logits alive; without them it may fit
+# — and if not, it's a recorded failure.
+SPACE = {
+    "remat_policy": ["dots_and_flash"],
+    "micro_batch": [16, 32, 64],
+    "model.loss_impl": ["fused_xent"],
+    "model.loss_fused_block_rows": [512, 1024],
+}
+
+
+def main(max_trials: int = 6, trial_timeout: float = 700.0):
+    exp_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fused_xent_r5_log")
+    tuner = Autotuner(lambda ov: None, BASE, lambda: None, steps=10, warmup=2,
+                      world_size=1, hbm_gb=16.0)
+    sched = ExperimentScheduler(exp_dir, trial_timeout=trial_timeout)
+    res = tuner.tune_isolated(
+        MODEL_CFG, {"size": B, "seq": S, "vocab": V}, sched,
+        space=SPACE, strategy="grid", max_trials=max_trials,
+        results_path=os.path.join(exp_dir, "fused_xent_r5.json"),
+    )
+    ok = [t for t in res.trials if t.status == "ok"]
+    print(json.dumps({
+        "trials": len(res.trials),
+        "ok": len(ok),
+        "handled_failures": len(res.trials) - len(ok),
+        "best": None if res.best is None else {
+            "overrides": res.best.overrides,
+            "tokens_per_sec": res.best.tokens_per_sec,
+            "step_ms": res.best.step_ms,
+        },
+        "control_tok_s": 104736.0,  # autotune_r5 winner (chunked loss)
+        "artifact": os.path.join(exp_dir, "fused_xent_r5.json"),
+    }))
+    return res
+
+
+if __name__ == "__main__":
+    args = sys.argv[1:]
+    main(int(args[0]) if args else 6,
+         float(args[1]) if len(args) > 1 else 700.0)

@@ -1,0 +1,97 @@
+"""Fused projection+xent kernel vs the plain XLA loss (interpret mode on CPU
+— same strategy as tests/test_flash.py: the kernels run unmodified, Mosaic
+only changes the executor on real TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.pallas.fused_xent import fused_linear_xent
+
+
+def _ref_nll(h, w, y):
+    logits = (h.astype(jnp.float32) @ w.astype(jnp.float32))
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, jnp.maximum(y, 0)[:, None], axis=-1)[:, 0]
+    return logz - gold
+
+
+def _masked_mean(nll, y):
+    mask = (y >= 0).astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+@pytest.mark.smoke
+@pytest.mark.parametrize("vocab", [512, 777])  # 777: vocab padding + masking
+def test_fused_xent_matches_xla(vocab):
+    k = jax.random.PRNGKey(0)
+    N, D = 256, 128
+    h = jax.random.normal(k, (N, D), jnp.float32) * 0.3
+    w = jax.random.normal(jax.random.fold_in(k, 1), (D, vocab), jnp.float32) * 0.1
+    y = jax.random.randint(jax.random.fold_in(k, 2), (N,), 0, vocab)
+    y = y.at[::7].set(-1)  # ignored rows
+
+    nll = fused_linear_xent(h, w, y, block_rows=128, block_v=128, interpret=True)
+    ref = _ref_nll(h, w, y)
+    real = np.asarray(y) >= 0
+    np.testing.assert_allclose(
+        np.asarray(nll)[real], np.asarray(ref)[real], rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.smoke
+def test_fused_xent_grads_match_xla():
+    k = jax.random.PRNGKey(3)
+    N, D, V = 256, 128, 640
+    h = jax.random.normal(k, (N, D), jnp.float32) * 0.3
+    w = jax.random.normal(jax.random.fold_in(k, 1), (D, V), jnp.float32) * 0.1
+    y = jax.random.randint(jax.random.fold_in(k, 2), (N,), 0, V)
+    y = y.at[::5].set(-1)
+
+    def fused_loss(h, w):
+        return _masked_mean(
+            fused_linear_xent(h, w, y, block_rows=128, block_v=128,
+                              interpret=True), y)
+
+    def ref_loss(h, w):
+        return _masked_mean(_ref_nll(h, w, y), y)
+
+    (lf, (dhf, dwf)) = jax.value_and_grad(fused_loss, argnums=(0, 1))(h, w)
+    (lr, (dhr, dwr)) = jax.value_and_grad(ref_loss, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(float(lf), float(lr), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dhf), np.asarray(dhr), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dwf), np.asarray(dwr), rtol=1e-4, atol=1e-5)
+    # ignored rows contribute exactly zero hidden-gradient
+    assert np.abs(np.asarray(dhf)[::5]).max() == 0.0
+
+
+def test_model_loss_impl_fused_matches_chunked():
+    """End-to-end: TransformerConfig(loss_impl='fused_xent') computes the same
+    loss and parameter gradients as the chunked scan path."""
+    from deepspeed_tpu.models.transformer import (
+        Model, TransformerConfig, causal_lm_loss)
+
+    base = dict(vocab_size=777, hidden_size=128, num_layers=2, num_heads=4,
+                max_seq_len=128, loss_chunk_size=64)
+    cfg_c = TransformerConfig(**base)
+    cfg_f = TransformerConfig(**base, loss_impl="fused_xent",
+                              loss_fused_block_rows=128, loss_fused_block_v=128)
+    params = Model(cfg_c).init(jax.random.PRNGKey(0))
+    # 129 tokens -> 128 labels, so B*S = 256 rows actually takes the fused
+    # path (split_batch shifts by one)
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(1), (2, 129), 0, 777)}
+
+    lc, gc = jax.value_and_grad(lambda p: causal_lm_loss(cfg_c, p, batch))(params)
+    import warnings
+
+    with warnings.catch_warnings():
+        # the fused->chunked fallback warns; erroring here proves the fused
+        # path is the one actually under test
+        warnings.simplefilter("error")
+        lf, gf = jax.value_and_grad(lambda p: causal_lm_loss(cfg_f, p, batch))(params)
+    np.testing.assert_allclose(float(lf), float(lc), rtol=1e-5)
+    flat_c = jax.tree_util.tree_leaves(gc)
+    flat_f = jax.tree_util.tree_leaves(gf)
+    for a, b in zip(flat_c, flat_f):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=2e-4, atol=1e-5)
