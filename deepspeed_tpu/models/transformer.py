@@ -391,7 +391,8 @@ def _stream_top_level(cfg: TransformerConfig, params: Params) -> Params:
     return out
 
 
-_SAVED_NAMES = {"save_flash": ("flash_out", "flash_lse"), "nothing_saveable": ()}
+_SAVED_NAMES = {"save_flash": ("flash_out", "flash_lse", "xent_lse"),
+                "nothing_saveable": ()}
 
 
 def _remat_policy(name: str, offload: bool = False):

@@ -1,0 +1,62 @@
+"""Probe the TPU worker host's pinned-memory ceiling.
+
+offload_2b7 (offload_param_r4.py) crashed the TPU worker on its first step:
+~37 GB of host-pinned state (fp32 masters + moments + bf16 params) where the
+round-4 1.31B run (17.1 GB) trained fine. Before burning another chip-queue
+attempt on the same crash, find the wall: allocate ascending pinned-host
+arrays ON THE WORKER (computed under jit with pinned_host out-shardings —
+nothing big crosses the tunnel) and record the largest that survives a
+touch-and-readback. The log's last "ok" line before a crash IS the result.
+
+Usage: python experiments/host_ram_probe.py [max_gb]
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+from deepspeed_tpu.utils.jax_env import apply_platform_env
+
+apply_platform_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(max_gb: float = 48.0):
+    dev = jax.devices()[0]
+    print(json.dumps({"platform": dev.platform}), flush=True)
+    sharding = jax.sharding.SingleDeviceSharding(dev, memory_kind="pinned_host")
+    gb = 4.0
+    results = []
+    while gb <= max_gb:
+        n = int(gb * (1 << 30) // 4)
+        t0 = time.time()
+        try:
+            f = jax.jit(lambda: jnp.full((n,), 1.0, jnp.float32),
+                        out_shardings=sharding)
+            buf = f()
+            # touch both ends so the pages are really committed
+            lo = float(np.asarray(jax.device_get(buf[0])))
+            hi = float(np.asarray(jax.device_get(buf[-1])))
+            assert lo == 1.0 and hi == 1.0
+            results.append(gb)
+            print(json.dumps({"pinned_host_gb": gb, "status": "ok",
+                              "elapsed_s": round(time.time() - t0, 1)}),
+                  flush=True)
+            del buf
+        except Exception as e:  # worker crash surfaces as RuntimeError
+            print(json.dumps({"pinned_host_gb": gb, "status": "failed",
+                              "error": f"{type(e).__name__}: {str(e)[:200]}"}),
+                  flush=True)
+            break
+        gb += 4.0 if gb < 16 else 8.0
+    print(json.dumps({"max_ok_pinned_host_gb": results[-1] if results else 0}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main(float(sys.argv[1]) if len(sys.argv) > 1 else 48.0)
