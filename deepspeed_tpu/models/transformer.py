@@ -98,6 +98,14 @@ class TransformerConfig:
     remat_offload: bool = False
     remat_partition_axis: str = ""
     remat_group: int = 0
+    # lax.scan unroll over the layer stack. >1 puts that many layers in one
+    # loop body so XLA's latency-hiding scheduler can start layer i+1's
+    # host->HBM parameter copy while layer i computes — the double-buffering
+    # the ZeRO-Infinity param tier (runtime/zero/param_offload.py) needs to
+    # stop serializing on the stream (the reference's prefetch coordinator
+    # plays this role, runtime/zero/parameter_offload.py). Costs one extra
+    # layer's params resident per unroll step; no effect on math.
+    scan_unroll: int = 1
     dtype: Any = jnp.float32  # compute dtype (params always stored fp32)
     moe_every: int = 0  # >0: every Nth layer is an MoE FFN (see moe/)
     num_experts: int = 1
@@ -812,6 +820,8 @@ def apply(
     def maybe_remat(f):
         return jax.checkpoint(f, policy=policy, prevent_cse=False) if cfg.remat else f
 
+    unroll = max(1, cfg.scan_unroll)
+
     aux_total = jnp.zeros((), jnp.float32)
     E = cfg.moe_every
     if E > 0 and "moe" in params and L % E == 0:
@@ -826,13 +836,15 @@ def apply(
             x = tag(carry)
             if E > 1:
                 dense_part = jax.tree.map(lambda a: a[: E - 1], lg)
-                x, _ = lax.scan(scan_body, x, dense_part)
+                x, _ = lax.scan(scan_body, x, dense_part,
+                                unroll=unroll)
             lp_last = load_layer(jax.tree.map(lambda a: a[E - 1], lg))
             x, aux = _moe_layer(
                 cfg, lp_last, load_moe(moe_p), x, attn_fn, bias, positions, local_bias)
             return x, aux
 
-        x, auxs = lax.scan(maybe_remat(group_body), x, (layers_g, moe_xs))
+        x, auxs = lax.scan(maybe_remat(group_body), x, (layers_g, moe_xs),
+                           unroll=unroll)
         aux_total = jnp.sum(auxs)
     elif E > 0:
         # non-uniform depth: python loop fallback
@@ -860,12 +872,15 @@ def apply(
                 lambda a: a.reshape((L // Gsz, Gsz) + a.shape[1:]), layers_xs)
 
             def remat_group_body(carry, lg):
-                x, _ = lax.scan(scan_body, tag(carry), lg)
+                x, _ = lax.scan(scan_body, tag(carry), lg,
+                                unroll=unroll)
                 return x, None
 
-            x, _ = lax.scan(maybe_remat(remat_group_body), x, layers_gr)
+            x, _ = lax.scan(maybe_remat(remat_group_body), x, layers_gr,
+                            unroll=unroll)
         else:
-            x, _ = lax.scan(maybe_remat(tagged_body), x, layers_xs)
+            x, _ = lax.scan(maybe_remat(tagged_body), x, layers_xs,
+                            unroll=unroll)
 
     if cfg.final_ln:
         x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon)

@@ -29,6 +29,9 @@ QUEUE = [
     ("roofline_r5", [sys.executable, os.path.join(HERE, "roofline_r5.py")], 1800, 2),
     ("fused_xent_r5", [sys.executable, os.path.join(HERE, "fused_xent_r5.py")], 2500, 2),
     ("host_ram_probe", [sys.executable, os.path.join(HERE, "host_ram_probe.py")], 1200, 2),
+    # unroll=2 A/B at the proven 1b3 scale: r4 recorded 5.8 s/step at
+    # unroll=1 — does cross-layer stream/compute overlap move it?
+    ("offload_1b3_unroll2", [sys.executable, os.path.join(HERE, "offload_param_r4.py"), "1b3", "4", "2"], 2400, 2),
     ("offload_2b7", [sys.executable, os.path.join(HERE, "offload_param_r4.py"), "2b7"], 2400, 2),
     ("nvme_1b3", [sys.executable, os.path.join(HERE, "offload_nvme_r5.py"), "1b3"], 2400, 2),
     ("infer_7b_int8_b1", [sys.executable, os.path.join(REPO, "benchmarks", "inference_latency.py"),

@@ -3,10 +3,15 @@
 offload_2b7 (offload_param_r4.py) crashed the TPU worker on its first step:
 ~37 GB of host-pinned state (fp32 masters + moments + bf16 params) where the
 round-4 1.31B run (17.1 GB) trained fine. Before burning another chip-queue
-attempt on the same crash, find the wall: allocate ascending pinned-host
-arrays ON THE WORKER (computed under jit with pinned_host out-shardings —
-nothing big crosses the tunnel) and record the largest that survives a
-touch-and-readback. The log's last "ok" line before a crash IS the result.
+attempt on the same crash, find the wall.
+
+Method: accumulate 4 GB pinned-host buffers (each one computed on-device —
+well under HBM — then landed in the ``pinned_host`` memory space by the
+out-sharding, so no iteration ever stresses HBM and nothing big crosses the
+tunnel). After each allocation, a tiny jitted reduction over the newest
+buffer (host-memory in-sharding) verifies the pages are really committed.
+The log's last "ok" line before a crash IS the result: cumulative GB the
+worker host could pin.
 
 Usage: python experiments/host_ram_probe.py [max_gb]
 """
@@ -25,37 +30,40 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+CHUNK_GB = 4.0
+
 
 def main(max_gb: float = 48.0):
     dev = jax.devices()[0]
     print(json.dumps({"platform": dev.platform}), flush=True)
-    sharding = jax.sharding.SingleDeviceSharding(dev, memory_kind="pinned_host")
-    gb = 4.0
-    results = []
-    while gb <= max_gb:
-        n = int(gb * (1 << 30) // 4)
+    host = jax.sharding.SingleDeviceSharding(dev, memory_kind="pinned_host")
+    n = int(CHUNK_GB * (1 << 30) // 4)
+    alloc = jax.jit(lambda i: jnp.full((n,), 1.0, jnp.float32) + i,
+                    out_shardings=host)
+    # strided checksum compiled once; host-space input, scalar device output
+    touch = jax.jit(lambda b: jnp.sum(b[:: 1 << 20]), in_shardings=host)
+
+    held = []
+    ok_gb = 0.0
+    while ok_gb + CHUNK_GB <= max_gb:
         t0 = time.time()
         try:
-            f = jax.jit(lambda: jnp.full((n,), 1.0, jnp.float32),
-                        out_shardings=sharding)
-            buf = f()
-            # touch both ends so the pages are really committed
-            lo = float(np.asarray(jax.device_get(buf[0])))
-            hi = float(np.asarray(jax.device_get(buf[-1])))
-            assert lo == 1.0 and hi == 1.0
-            results.append(gb)
-            print(json.dumps({"pinned_host_gb": gb, "status": "ok",
-                              "elapsed_s": round(time.time() - t0, 1)}),
-                  flush=True)
-            del buf
-        except Exception as e:  # worker crash surfaces as RuntimeError
-            print(json.dumps({"pinned_host_gb": gb, "status": "failed",
-                              "error": f"{type(e).__name__}: {str(e)[:200]}"}),
-                  flush=True)
+            buf = alloc(jnp.float32(len(held)))
+            s = float(np.asarray(jax.device_get(touch(buf))))
+            expected = (1.0 + len(held)) * (n // (1 << 20) + (1 if n % (1 << 20) else 0))
+            held.append(buf)
+            ok_gb += CHUNK_GB
+            print(json.dumps({
+                "cumulative_pinned_host_gb": ok_gb, "status": "ok",
+                "checksum_ok": abs(s - expected) < 1e-3,
+                "elapsed_s": round(time.time() - t0, 1)}), flush=True)
+        except Exception as e:  # worker crash/OOM surfaces here
+            print(json.dumps({
+                "cumulative_pinned_host_gb": ok_gb + CHUNK_GB,
+                "status": "failed",
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}), flush=True)
             break
-        gb += 4.0 if gb < 16 else 8.0
-    print(json.dumps({"max_ok_pinned_host_gb": results[-1] if results else 0}),
-          flush=True)
+    print(json.dumps({"max_ok_pinned_host_gb": ok_gb}), flush=True)
 
 
 if __name__ == "__main__":

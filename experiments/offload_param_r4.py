@@ -33,12 +33,16 @@ PRESETS = {
 }
 
 
-def main(preset: str = "1b3", steps: int = 4):
+def main(preset: str = "1b3", steps: int = 4, unroll: int = 1):
     L, d, H, S, B = PRESETS[preset]
+    steps, unroll = int(steps), int(unroll)
     tcfg = TransformerConfig(
         vocab_size=50304, max_seq_len=S, num_layers=L, num_heads=H,
         hidden_size=d, dtype=jnp.bfloat16, attn_impl="flash",
         remat=True, remat_policy="save_flash", loss_chunk_size=512,
+        # unroll=2: two layers per loop body lets XLA overlap layer i+1's
+        # host->HBM param stream with layer i's compute (scan_unroll doc)
+        scan_unroll=unroll,
     )
     model = Model(tcfg)
     n_params = (
@@ -95,6 +99,7 @@ def main(preset: str = "1b3", steps: int = 4):
     step_s = float(np.median(times))
     rec = {
         "preset": preset,
+        "scan_unroll": unroll,
         "n_params_b": round(n_params / 1e9, 3),
         "step_s": round(step_s, 3),
         "tokens_per_s": round(B * S / step_s, 1),

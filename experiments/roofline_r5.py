@@ -70,7 +70,9 @@ def matmul_tflops(m, k, n, reps=30):
 
     def step(i):
         a2 = a.at[0, 0].add(i.astype(jnp.bfloat16))  # loop-variant: no hoisting
-        return (a2 @ b)[0, 0].astype(jnp.float32)
+        # reduce the FULL product: slicing one element lets XLA reorder the
+        # slice above the dot and time a k-length dot instead of the GEMM
+        return jnp.sum((a2 @ b).astype(jnp.float32))
 
     dt = timed_scan(step, reps=reps)
     return 2 * m * k * n / dt / 1e12, dt
@@ -106,7 +108,7 @@ def main():
                                 block_q=1024, block_k=1024)
             return jnp.sum(o.astype(jnp.float32))
         q2 = q.at[0, 0, 0, 0].add(i.astype(jnp.bfloat16))
-        return jax.grad(loss)(q2)[0, 0, 0, 0].astype(jnp.float32)
+        return jnp.sum(jax.grad(loss)(q2).astype(jnp.float32))
 
     dt = timed_scan(attn_step, reps=20)
     # fwd 4*S*S*Dh MACs per head (QK^T+AV) /2 causal, bwd ~2.5x fwd
@@ -123,9 +125,9 @@ def main():
 
     def ln_step(i):
         x2 = x.at[0, 0, 0].add(i.astype(jnp.bfloat16))
-        return jax.grad(
+        return jnp.sum(jax.grad(
             lambda x: jnp.sum(layer_norm(x, sc, bi, 1e-5).astype(jnp.float32))
-        )(x2)[0, 0, 0].astype(jnp.float32)
+        )(x2).astype(jnp.float32))
 
     dt = timed_scan(ln_step, reps=30)
     rows.append({"component": "layernorm_fwd+bwd", "shape": [MICRO, S, D],
