@@ -51,11 +51,17 @@ def main(max_gb: float = 48.0):
             buf = alloc(jnp.float32(len(held)))
             s = float(np.asarray(jax.device_get(touch(buf))))
             expected = (1.0 + len(held)) * (n // (1 << 20) + (1 if n % (1 << 20) else 0))
+            if abs(s - expected) >= 1e-3:
+                # pages silently failed to commit -- that IS the wall
+                print(json.dumps({
+                    "cumulative_pinned_host_gb": ok_gb + CHUNK_GB,
+                    "status": "failed", "error": f"checksum {s} != {expected}"}),
+                    flush=True)
+                break
             held.append(buf)
             ok_gb += CHUNK_GB
             print(json.dumps({
                 "cumulative_pinned_host_gb": ok_gb, "status": "ok",
-                "checksum_ok": abs(s - expected) < 1e-3,
                 "elapsed_s": round(time.time() - t0, 1)}), flush=True)
         except Exception as e:  # worker crash/OOM surfaces here
             print(json.dumps({

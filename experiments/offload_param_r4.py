@@ -7,7 +7,9 @@ memory; each scanned layer streams its slice into HBM just-in-time
 (runtime/zero/param_offload.py). Records step time, tokens/s, and the
 device memory high-water mark.
 
-Usage: python experiments/offload_param_r4.py [preset]
+Usage: python experiments/offload_param_r4.py [preset] [steps] [unroll]
+(unroll=2 batches two layers per scan body so the next layer's
+host->HBM stream overlaps the current layer's compute -- scan_unroll)
 Presets: 1b3 | 2b7 | 6b7
 """
 

@@ -11,6 +11,10 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 os.environ["JAX_PLATFORMS"] = "cpu"
+# NOTE: cache loads emit benign E-level "machine feature" lines (same-machine
+# AOT bookkeeping); pytest captures stderr per test, so they surface only on
+# failures — deliberately not suppressed (TF_CPP_MIN_LOG_LEVEL=3 would also
+# hide real XLA errors).
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
@@ -19,6 +23,17 @@ import pytest  # noqa: E402
 # jax.config; tests always run on the 8-device virtual CPU mesh.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+# Persistent XLA compilation cache: the suite is COMPILE-bound on a 1-core
+# box (~40 min cold; the smoke tier alone is ~7 min), and the programs are
+# identical run to run — the cache turns warm re-runs into load-and-execute.
+# Keyed by HLO hash, so code changes invalidate exactly the affected tests.
+# Opt out with DSTPU_TEST_NO_XLA_CACHE=1 (e.g. to measure true compile time).
+if not os.environ.get("DSTPU_TEST_NO_XLA_CACHE"):
+    _cache_dir = os.path.join(os.path.dirname(__file__), ".xla_cache")
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
 @pytest.fixture

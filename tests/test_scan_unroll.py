@@ -9,9 +9,10 @@ import pytest
 from deepspeed_tpu.models.transformer import Model, TransformerConfig, causal_lm_loss
 
 
-@pytest.mark.smoke
 @pytest.mark.parametrize("variant", [
-    "plain", "remat",
+    "plain",
+    pytest.param("remat", marks=pytest.mark.smoke),  # offload configs' path;
+    # the other variants compile two full programs each — full-tier only
     "remat_group",  # nested remat_group_body scans (offload configs use these)
     "moe",          # grouped E-dense+MoE scan
 ])
