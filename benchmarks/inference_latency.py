@@ -101,6 +101,10 @@ def main():
     ap.add_argument("--int8", action="store_true",
                     help="int8 weight-only storage, random-init in quantized "
                          "form (multi-billion models on one 16 GB chip)")
+    ap.add_argument("--dry-trace", action="store_true",
+                    help="trace the prefill/decode/generate programs at the "
+                         "requested shapes without compiling or executing — "
+                         "CPU-side de-risk before burning a chip window")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
@@ -146,6 +150,21 @@ def main():
     )
 
     cache = tfm.init_cache(cfg, B, Smax, dtype=cfg.dtype)
+
+    if args.dry_trace:
+        abstract = lambda t: jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), t)
+        ap_, cp_ = abstract(params), abstract(cache)
+        tp_ = jax.ShapeDtypeStruct((B, prompt_len), jnp.int32)
+        t1_ = jax.ShapeDtypeStruct((B, 1), jnp.int32)
+        n1 = len(prefill.lower(ap_, tp_, cp_).as_text())
+        n2 = len(decode.lower(ap_, t1_, cp_, prompt_len).as_text())
+        print(json.dumps({"metric": f"{name} dry-trace", "batch": B,
+                          "prefill_hlo_kchars": n1 // 1000,
+                          "decode_hlo_kchars": n2 // 1000, "ok": True}),
+              flush=True)
+        return
+
     logits, cache = prefill(params, jnp.asarray(prompt), cache)  # compile
     _sync(logits)
     # median of several calls — a single timed call right after compilation
