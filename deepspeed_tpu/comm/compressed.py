@@ -128,8 +128,8 @@ def _shard_map_per_rank(make_per_device, axis, mesh, n_args, n_outs):
     ``make_per_device(world)`` over ``axis`` with every arg/output carried
     as [world] per-rank rows except output 0 (the rank-identical average)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
 
+    from ..utils.jax_compat import shard_map
     from .mesh import current_mesh
 
     mesh = mesh if mesh is not None else current_mesh()

@@ -1,3 +1,4 @@
 """Inference stack (reference: deepspeed/inference/)."""
 
 from .engine import InferenceEngine
+from .serving import Request, RequestResult, ServingEngine

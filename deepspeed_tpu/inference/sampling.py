@@ -56,14 +56,82 @@ def apply_top_p(logits, p: float):
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    # keep tokens while the cumulative mass BEFORE them is < p (the first
-    # token is always kept)
-    keep_sorted = (cum - probs) < p
+    # keep tokens while the cumulative mass BEFORE them is < p; the first
+    # token is forced kept (p <= 0 would otherwise mask EVERY logit and
+    # categorical would degenerate to token 0)
+    keep_sorted = ((cum - probs) < p).at[..., 0].set(True)
     # threshold = smallest kept logit
     thresh = jnp.min(
         jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
     )
     return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def apply_top_k_vector(logits, k):
+    """Per-row top-k: logits [B, V], k [B] int32 (<= 0 disables that row).
+
+    The threshold is data (the k-th largest logit per row), so distinct
+    per-request k values NEVER change the compiled program — the property the
+    continuous-batching decode step needs to compile exactly once."""
+    V = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    idx = jnp.clip(k - 1, 0, V - 1)
+    thresh = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)  # [B, 1]
+    enabled = (k > 0) & (k < V)
+    return jnp.where(enabled[:, None] & (logits < thresh), NEG_INF, logits)
+
+
+def apply_top_p_vector(logits, p):
+    """Per-row nucleus sampling: logits [B, V], p [B] fp32 (>= 1 disables;
+    p <= 0 degenerates to top-1)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = ((cum - probs) < p[:, None]).at[..., 0].set(True)
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    enabled = p < 1.0
+    return jnp.where(enabled[:, None] & (logits < thresh), NEG_INF, logits)
+
+
+def sample_logits_vector(logits, rng, temperature, top_k, top_p):
+    """Per-slot sampling: logits [B, V] with PER-ROW sampler state as arrays
+    (temperature/top_k/top_p all [B]) -> token ids [B] int32.
+
+    Rows with temperature <= 0 take the greedy argmax. Every sampler knob is
+    an array operand, so admitting a request with new sampling params reuses
+    the already-compiled decode step (the ServingEngine contract).
+
+    ONE [B, V] sort serves both filters (this runs every decode step; the
+    O(V log V) sort dominates sampling cost at real vocabs): top-k masks a
+    suffix of the descending sort to NEG_INF, which keeps it sorted, so the
+    nucleus pass reuses it — identical semantics to applying
+    ``apply_top_k_vector`` then ``apply_top_p_vector`` in sequence."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.asarray(temperature, jnp.float32)
+    scaled = logits / jnp.maximum(t, 1e-6)[:, None]
+    V = scaled.shape[-1]
+    k = jnp.asarray(top_k, jnp.int32)
+    p = jnp.asarray(top_p, jnp.float32)
+
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(sorted_desc, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1)
+    k_on = ((k > 0) & (k < V))[:, None]
+    scaled = jnp.where(k_on & (scaled < kth), NEG_INF, scaled)
+    sorted_desc = jnp.where(k_on & (sorted_desc < kth), NEG_INF, sorted_desc)
+
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # first token forced kept: p <= 0 must degenerate to top-1, not to an
+    # all-masked row that categorical resolves as token 0
+    keep_sorted = ((cum - probs) < p[:, None]).at[..., 0].set(True)
+    pth = jnp.min(jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1, keepdims=True)
+    scaled = jnp.where((p < 1.0)[:, None] & (scaled < pth), NEG_INF, scaled)
+
+    drawn = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(t <= 0.0, greedy, drawn).astype(jnp.int32)
 
 
 def sample_logits(logits, rng, cfg: SamplerConfig, seen=None):

@@ -1,0 +1,398 @@
+"""Continuous-batching serving engine: slot-based KV cache, ONE compiled
+decode step, bucketed prefill.
+
+The reference's inference pillar (deepspeed/inference/engine.py) serves a
+single static batch per call; heavy multi-tenant traffic needs Orca-style
+continuous batching (requests join/leave mid-decode) and vLLM-style slot
+management of the KV cache. On TPU both reduce to what this codebase is
+built around — a small number of long-lived, statically-shaped compiled
+programs over sharded state:
+
+  * persistent slot cache  — one sharded [L, n_slots, Smax, H, Dh] k/v pair
+                             lives across the whole serving session (slots
+                             over the data/fsdp axes, heads over the TP axis;
+                             parallel/sharding.kv_slot_cache_spec). A request
+                             occupies one slot from admission to eviction.
+  * ONE decode program     — ``decode_step`` advances EVERY slot by one token
+                             per device call. Per-slot position is a [n]
+                             vector (models/transformer.apply_with_cache),
+                             per-slot sampler state is arrays (temperature /
+                             top-k / top-p — inference/sampling.
+                             sample_logits_vector), so admitting a request
+                             with a new prompt length, sampling params, or
+                             arrival time NEVER recompiles: the program
+                             compiles exactly once per engine lifetime.
+  * bucketed prefill       — prompts are padded to power-of-two length
+                             buckets; one compiled program per bucket writes
+                             the prompt's KV into a free slot via
+                             ``dynamic_update_slice`` and samples the first
+                             token at the live prompt position
+                             (``last_index`` — never materializing the
+                             padded tail's logits).
+  * host scheduler         — admission queue ordered by arrival, slot
+                             eviction on EOS / max-tokens, request→response
+                             bookkeeping, and a wall-clock ``serve`` driver.
+
+Inactive slots still flow through the decode program (static shapes are the
+whole point); their writes land at position 0 of a free slot and are
+overwritten by the next prefill, and their sampled tokens are discarded by
+the host. Repetition penalty is NOT supported here: its [n_slots, vocab]
+"seen" carry would dominate the cache HBM for large vocabs — use
+``InferenceEngine.generate`` for penalty-constrained decoding.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+from ..models import transformer as tfm
+from ..parallel.sharding import kv_slot_cache_spec
+from ..utils.logging import log_dist
+from .engine import InferenceEngine
+from .sampling import sample_logits_vector
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass
+class Request:
+    """One generation request. ``arrival_time`` is seconds relative to the
+    engine epoch (0.0 = already arrived). step() admits once its clock —
+    wall time by default, or the caller's ``now`` — has passed it; drain()
+    ignores it entirely."""
+
+    uid: int
+    prompt: np.ndarray  # [S] int32
+    max_new_tokens: int = 32
+    temperature: float = 0.0  # <= 0 greedy
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0  # 1.0 = disabled
+    eos_token: Optional[int] = None
+    arrival_time: float = 0.0
+
+
+@dataclass
+class RequestResult:
+    uid: int
+    tokens: np.ndarray  # [n_generated] int32 (includes eos if emitted)
+    prompt_len: int
+    arrival_time: float
+    admitted_time: float = 0.0
+    first_token_time: float = 0.0  # TTFT reference point
+    finish_time: float = 0.0
+    slot: int = -1
+
+    @property
+    def ttft(self) -> float:
+        return self.first_token_time - self.arrival_time
+
+    @property
+    def time_per_output_token(self) -> float:
+        n = len(self.tokens)
+        if n <= 1:
+            return 0.0
+        return (self.finish_time - self.first_token_time) / (n - 1)
+
+
+@dataclass
+class _Slot:
+    uid: int = -1
+    remaining: int = 0
+    eos: int = -1  # -1 = never matches
+    result: Optional[RequestResult] = None
+    tokens: list = field(default_factory=list)
+
+
+class ServingEngine:
+    """Continuous batching over an ``InferenceEngine``'s model/params.
+
+    Config keys (``config`` dict or keyword arguments; kwargs win):
+      n_slots             concurrent sequences resident in the slot cache
+      max_seq_len         per-slot admission budget (prompt + generated);
+                          must not exceed the engine's sequence budget. Only
+                          the cache allocation rounds up to a multiple of
+                          128 (Pallas decode-kernel block streaming).
+                          Default: the engine's sequence budget.
+      min_prefill_bucket  smallest prompt bucket (power of two padding floor)
+      seed                sampler PRNG seed
+    """
+
+    def __init__(self, engine: InferenceEngine, config: dict | None = None,
+                 *, n_slots: int | None = None, max_seq_len: int | None = None,
+                 min_prefill_bucket: int | None = None, seed: int | None = None):
+        config = dict(config or {})
+        n_slots = n_slots if n_slots is not None else config.get("n_slots", 8)
+        max_seq_len = max_seq_len if max_seq_len is not None else config.get(
+            "max_seq_len", min(engine.cfg.max_seq_len, engine.max_out_tokens))
+        min_prefill_bucket = (min_prefill_bucket if min_prefill_bucket is not None
+                              else config.get("min_prefill_bucket", 16))
+        seed = seed if seed is not None else config.get("seed", 0)
+
+        self.engine = engine
+        self.cfg = engine.cfg
+        self.mesh = engine.mesh
+        self.params = engine.params
+        self.n_slots = int(n_slots)
+        # admission budget stays at the MODEL's sequence limit (a learned
+        # position table indexes out of range past it — jax clamps the gather
+        # and the output would be silently wrong); only the cache ALLOCATION
+        # rounds up to the 128 multiple the decode kernel's block streaming
+        # needs — those tail positions are never admitted into
+        engine_budget = min(engine.cfg.max_seq_len, engine.max_out_tokens)
+        self.budget = int(max_seq_len)
+        if self.budget > engine_budget:
+            raise ValueError(
+                f"max_seq_len ({self.budget}) exceeds the engine's sequence "
+                f"budget {engine_budget} (min of model max_seq_len "
+                f"{engine.cfg.max_seq_len} and max_out_tokens "
+                f"{engine.max_out_tokens})")
+        self.Smax = -(-self.budget // 128) * 128
+        self.min_bucket = int(min_prefill_bucket)
+        self._rng = jax.random.PRNGKey(seed)
+
+        spec = kv_slot_cache_spec(self.mesh, self.n_slots, self.cfg.num_heads)
+        self._cache_sharding = NamedSharding(self.mesh, spec)
+        # every program pins the cache OUTPUT to this sharding too — an
+        # inferred output sharding that differs from the input's would give
+        # the next call a differently-sharded operand and silently recompile
+        self._cache_shardings = {"k": self._cache_sharding, "v": self._cache_sharding}
+        self._cache = jax.jit(
+            partial(tfm.init_cache, self.cfg, self.n_slots, self.Smax,
+                    dtype=self.cfg.dtype),
+            out_shardings=self._cache_sharding,
+        )()
+
+        # host-side slot state (device twins are passed per step as arrays)
+        n = self.n_slots
+        self._slots = [_Slot() for _ in range(n)]
+        self._free: deque[int] = deque(range(n))
+        self._active = np.zeros((n,), np.bool_)
+        self._pos = np.zeros((n,), np.int32)
+        self._last_tok = np.zeros((n,), np.int32)
+        self._temp = np.zeros((n,), np.float32)
+        self._top_k = np.zeros((n,), np.int32)
+        self._top_p = np.ones((n,), np.float32)
+
+        self._queue: deque[Request] = deque()
+        self._results: dict[int, RequestResult] = {}
+        self._epoch = time.perf_counter()
+        self._decode = None  # jitted lazily (params pytree shapes needed)
+        self._prefills: dict[int, object] = {}  # bucket len -> jitted prefill
+        self._decode_steps = 0
+        log_dist(
+            f"serving engine: {n} slots x {self.Smax} tokens, cache "
+            f"{2 * self.cfg.num_layers * n * self.Smax * self.cfg.hidden_size * jnp.dtype(self.cfg.dtype).itemsize / 1e6:.1f} MB, "
+            f"spec={spec}", ranks=[0],
+        )
+
+    # -- compiled programs ----------------------------------------------
+
+    def _build_decode(self):
+        cfg = self.cfg
+
+        def decode(params, cache, toks, pos, active, rng, temp, top_k, top_p):
+            # toks/pos/active/temp/top_k/top_p are all [n_slots] ARRAYS —
+            # nothing about an individual request is baked into the program
+            logits, cache = tfm.apply_with_cache(cfg, params, toks[:, None], cache, pos)
+            nxt = sample_logits_vector(logits[:, 0], rng, temp, top_k, top_p)
+            return cache, jnp.where(active, nxt, 0)
+
+        return jax.jit(decode, donate_argnums=(1,),
+                       out_shardings=(self._cache_shardings, None))
+
+    def _build_prefill(self, bucket: int):
+        cfg = self.cfg
+
+        def prefill(params, cache, prompt, slot, true_len, rng, temp, top_k, top_p):
+            # prompt [1, bucket] (padded tail masked out by causality: the
+            # live tokens never attend to it, and its KV is overwritten by
+            # decode steps as the sequence grows into those positions)
+            local = tfm.init_cache(cfg, 1, bucket, dtype=cache["k"].dtype)
+            logits, local = tfm.apply_with_cache(
+                cfg, params, prompt, local, 0, last_index=true_len - 1)
+            tok = sample_logits_vector(logits[:, 0], rng, temp, top_k, top_p)
+            cache = {
+                kv: jax.lax.dynamic_update_slice(
+                    cache[kv], local[kv], (0, slot, 0, 0, 0))
+                for kv in ("k", "v")
+            }
+            return cache, tok
+
+        return jax.jit(prefill, donate_argnums=(1,),
+                       out_shardings=(self._cache_shardings, None))
+
+    def _bucket_len(self, S: int) -> int:
+        return min(_next_pow2(max(S, self.min_bucket)), self.Smax)
+
+    # -- scheduler ------------------------------------------------------
+
+    def submit(self, request: Request) -> int:
+        """Enqueue a request (admitted by the next step()/serve() iteration
+        whose clock has passed its arrival_time)."""
+        S = int(np.asarray(request.prompt).shape[-1])
+        if S + request.max_new_tokens > self.budget:
+            raise ValueError(
+                f"request {request.uid}: prompt ({S}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds the slot budget {self.budget}")
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"request {request.uid}: max_new_tokens must be >= 1 "
+                f"(got {request.max_new_tokens})")
+        # a duplicate uid would overwrite its twin's result and leave
+        # serve()'s completion count short — spinning forever
+        live = ({r.uid for r in self._queue} | set(self._results)
+                | {s.uid for s in self._slots if s.uid >= 0})
+        if request.uid in live:
+            raise ValueError(f"request uid {request.uid} is already in flight "
+                             "or finished; uids must be unique per engine")
+        self._queue.append(request)
+        return request.uid
+
+    @property
+    def n_active(self) -> int:
+        return int(self._active.sum())
+
+    def _admit(self, now: float):
+        """Move arrived requests from the queue into free slots (prefill)."""
+        while self._free and self._queue and self._queue[0].arrival_time <= now:
+            req = self._queue.popleft()
+            slot = self._free.popleft()
+            prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+            S = prompt.shape[0]
+            bucket = self._bucket_len(S)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :S] = prompt
+            if bucket not in self._prefills:
+                self._prefills[bucket] = self._build_prefill(bucket)
+            self._rng, k = jax.random.split(self._rng)
+            self._cache, tok = self._prefills[bucket](
+                self.params, self._cache, jnp.asarray(padded),
+                jnp.int32(slot), jnp.int32(S), k,
+                jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.top_p], jnp.float32),
+            )
+            first = int(np.asarray(jax.device_get(tok))[0])
+            t_first = time.perf_counter() - self._epoch
+            st = self._slots[slot]
+            st.uid = req.uid
+            st.remaining = req.max_new_tokens - 1
+            st.eos = req.eos_token if req.eos_token is not None else -1
+            st.tokens = [first]
+            st.result = RequestResult(
+                uid=req.uid, tokens=np.zeros((0,), np.int32), prompt_len=S,
+                arrival_time=req.arrival_time, admitted_time=t_first,
+                first_token_time=t_first, slot=slot,
+            )
+            self._active[slot] = True
+            self._pos[slot] = S
+            self._last_tok[slot] = first
+            self._temp[slot] = req.temperature
+            self._top_k[slot] = req.top_k
+            self._top_p[slot] = req.top_p
+            if first == st.eos or st.remaining <= 0:
+                self._finish(slot)
+
+    def _finish(self, slot: int):
+        st = self._slots[slot]
+        st.result.tokens = np.asarray(st.tokens, np.int32)
+        st.result.finish_time = time.perf_counter() - self._epoch
+        self._results[st.uid] = st.result
+        self._slots[slot] = _Slot()
+        self._active[slot] = False
+        self._pos[slot] = 0  # park: decode writes for a free slot land at 0,
+        self._last_tok[slot] = 0  # overwritten by the next prefill
+        self._temp[slot] = 0.0
+        self._top_k[slot] = 0
+        self._top_p[slot] = 1.0
+        self._free.append(slot)
+
+    def step(self, now: float | None = None) -> list[int]:
+        """One scheduler iteration: admit arrived requests, then advance
+        every active slot by one token (one device call). Returns the uids
+        finished during this step."""
+        if now is None:
+            now = time.perf_counter() - self._epoch
+        self._admit(now)
+        if not self._active.any():
+            return []
+        if self._decode is None:
+            self._decode = self._build_decode()
+        self._rng, k = jax.random.split(self._rng)
+        self._cache, nxt = self._decode(
+            self.params, self._cache, jnp.asarray(self._last_tok),
+            jnp.asarray(self._pos), jnp.asarray(self._active), k,
+            jnp.asarray(self._temp), jnp.asarray(self._top_k),
+            jnp.asarray(self._top_p),
+        )
+        self._decode_steps += 1
+        nxt = np.asarray(jax.device_get(nxt))
+        finished = []
+        for slot in range(self.n_slots):
+            if not self._active[slot]:
+                continue
+            st = self._slots[slot]
+            tok = int(nxt[slot])
+            st.tokens.append(tok)
+            st.remaining -= 1
+            self._pos[slot] += 1
+            self._last_tok[slot] = tok
+            if tok == st.eos or st.remaining <= 0:
+                uid = st.uid
+                self._finish(slot)
+                finished.append(uid)
+        return finished
+
+    def drain(self) -> dict[int, RequestResult]:
+        """Run steps until queue and slots are empty (ignoring arrival
+        times); return all results so far."""
+        while self._queue or self._active.any():
+            self.step(now=float("inf"))
+        return dict(self._results)
+
+    def serve(self, requests: list[Request]) -> dict[int, RequestResult]:
+        """Wall-clock driver: admit each request when its arrival_time has
+        passed, run continuous decode until every SUBMITTED request completes
+        (work already queued/in-flight keeps decoding alongside and stays in
+        flight if it outlives this call). Returns {uid: RequestResult} for
+        this call's requests, timed against the engine epoch — which is
+        reset only when the engine is idle, so in-flight requests' timings
+        stay coherent."""
+        if not self._queue and not self._active.any():
+            self._epoch = time.perf_counter()
+        target = set()
+        for r in sorted(requests, key=lambda r: r.arrival_time):
+            target.add(self.submit(r))
+        while not target <= set(self._results):
+            now = time.perf_counter() - self._epoch
+            if not self._active.any() and self._queue:
+                wait = self._queue[0].arrival_time - now
+                if wait > 0:
+                    time.sleep(min(wait, 0.05))
+            self.step()
+        return {u: self._results[u] for u in target}
+
+    # -- observability --------------------------------------------------
+
+    def compile_counts(self) -> dict:
+        """How many XLA programs this engine traced — the continuous-batching
+        invariant is decode == 1 regardless of workload mix."""
+        return {
+            "decode": int(self._decode._cache_size()) if self._decode is not None else 0,
+            "prefill": {b: int(f._cache_size()) for b, f in sorted(self._prefills.items())},
+            "decode_steps": self._decode_steps,
+        }

@@ -313,17 +313,26 @@ def xla_attention(q, k, v, *, causal_offset=0, bias=None, causal=True, dtype=jnp
     """Plain einsum attention [B,S,H,Dh] — the baseline the Pallas flash
     kernel is validated against (mirrors tests vs vendored BERT in the
     reference's test_cuda_forward.py strategy). ``causal=False`` gives the
-    bidirectional encoder form (BERT)."""
+    bidirectional encoder form (BERT). ``causal_offset`` may be a scalar or a
+    per-row [B] vector — continuous batching decodes every cache slot at its
+    own absolute position."""
     B, Sq, H, Dh = q.shape
     Sk = k.shape[1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / math.sqrt(Dh)
     if bias is not None:
         scores = scores + bias
     if causal:
-        q_pos = jnp.arange(Sq)[:, None] + causal_offset
-        k_pos = jnp.arange(Sk)[None, :]
-        mask = q_pos >= k_pos
-        scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+        off = jnp.asarray(causal_offset)
+        if off.ndim == 0:
+            q_pos = jnp.arange(Sq)[:, None] + off
+            k_pos = jnp.arange(Sk)[None, :]
+            mask = q_pos >= k_pos  # [Sq, Sk]
+            scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+        else:
+            q_pos = off[:, None, None] + jnp.arange(Sq)[None, :, None]
+            k_pos = jnp.arange(Sk)[None, None, :]
+            mask = q_pos >= k_pos  # [B, Sq, Sk]
+            scores = jnp.where(mask[:, None], scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -947,17 +956,29 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int, dtype=None):
 def cached_attention(q, k_cache, v_cache, pos, *, bias=None):
     """Attention of q [B,T,H,Dh] against a [B,Smax,H,Dh] cache whose valid
     keys are [0, pos+T): the causal mask with offset ``pos`` covers the
-    prefix, the new block's internal causality, and the padding tail."""
+    prefix, the new block's internal causality, and the padding tail.
+    ``pos`` may be a scalar (lock-step batch) or a per-row [B] vector
+    (continuous batching: each slot at its own position)."""
     return xla_attention(q, k_cache, v_cache, causal_offset=pos, bias=bias)
 
 
 def apply_with_cache(
-    cfg: TransformerConfig, params: Params, tokens, cache, pos, last_only: bool = False
+    cfg: TransformerConfig, params: Params, tokens, cache, pos,
+    last_only: bool = False, last_index=None,
 ):
     """tokens [B, T] entering at absolute position ``pos`` -> (logits, updated
     cache). Serves prefill (T=prompt) and decode (T=1). With ``last_only``
     only the final position is projected to the vocab (prefill never
-    materializes [B, S, V] — same motivation as the chunked LM loss).
+    materializes [B, S, V] — same motivation as the chunked LM loss);
+    ``last_index`` (traced scalar) projects only position ``last_index``
+    instead — bucketed prefill pads the prompt to the bucket length, so the
+    live last token sits mid-sequence, not at T-1.
+
+    ``pos`` may be a scalar (all rows in lock-step — the one-shot generate
+    path) or a per-row [B] int32 vector (continuous batching: every cache
+    slot decodes at its own absolute position; cache writes become per-row
+    scatters and the causal mask is per-row).
+
     MoE models decode through the same grouped scan as training (every
     ``moe_every``-th layer routes its FFN through the experts)."""
     if cfg.moe_every > 0 and ("moe" not in params or cfg.num_layers % cfg.moe_every):
@@ -982,7 +1003,12 @@ def apply_with_cache(
     moe_xs, load_moe = (None, lambda t: t)
     if "moe" in params:
         moe_xs, load_moe = _make_stack_loader(cfg, params["moe"])
-    positions = pos + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    pos = jnp.asarray(pos, jnp.int32)
+    vector_pos = pos.ndim >= 1
+    if vector_pos:
+        positions = pos[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    else:
+        positions = pos + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     x, _ = embed(cfg, params, tokens, positions)
 
     bias = None
@@ -990,8 +1016,12 @@ def apply_with_cache(
         # alibi distances vs absolute key positions, rows = new tokens
         slopes = alibi_slopes(cfg.num_heads)
         Smax = cache["k"].shape[2]
-        dist = jnp.arange(Smax)[None, :] - (pos + jnp.arange(T)[:, None])
-        bias = (slopes[:, None, None] * dist[None]).astype(jnp.float32)[None]
+        if vector_pos:
+            dist = jnp.arange(Smax)[None, None, :] - positions[:, :, None]  # [B,T,Smax]
+            bias = (slopes[None, :, None, None] * dist[:, None]).astype(jnp.float32)
+        else:
+            dist = jnp.arange(Smax)[None, :] - (pos + jnp.arange(T)[:, None])
+            bias = (slopes[:, None, None] * dist[None]).astype(jnp.float32)[None]
 
     # Single-token decode steps route through the Pallas length-aware kernel
     # (ops/pallas/decode_attention.py — the reference's softmax_context,
@@ -1001,12 +1031,27 @@ def apply_with_cache(
     if use_decode_kernel:
         from ..ops.pallas.decode_attention import decode_attention
 
+    if vector_pos:
+        _rows = jnp.arange(B)[:, None]
+
+        def _write_cache(c, new):
+            # per-row scatter: row b's block lands at [pos[b], pos[b]+T).
+            # Freed serving slots are parked at pos 0 — their garbage write
+            # is overwritten by the next occupant's prefill (which rewrites
+            # [0, bucket)); mode="drop" is defense-in-depth discarding any
+            # out-of-range position a caller might pass
+            return c.at[_rows, positions].set(new.astype(c.dtype), mode="drop")
+    else:
+
+        def _write_cache(c, new):
+            return lax.dynamic_update_slice(c, new.astype(c.dtype), (0, pos, 0, 0))
+
     def layer_core(x, lp, k_cache, v_cache, ffn_fn):
         lp = _dequant_layer(cfg, lp)
         h = layer_norm(x, lp["ln1_scale"], lp["ln1_bias"], cfg.layernorm_epsilon)
         q, k, v = _qkv_proj(cfg, lp, h, positions)
-        k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+        k_cache = _write_cache(k_cache, k)
+        v_cache = _write_cache(v_cache, v)
         if use_decode_kernel:
             attn = decode_attention(q[:, 0], k_cache, v_cache, pos)[:, None]
         else:
@@ -1070,7 +1115,11 @@ def apply_with_cache(
         new_v = new_v_g.reshape((cfg.num_layers,) + new_v_g.shape[2:])
     else:
         x, (new_k, new_v) = lax.scan(layer, x, (layers_xs, cache["k"], cache["v"]))
-    if last_only:
+    if last_index is not None:
+        # bucketed prefill: the live last token sits at ``last_index``
+        # (prompt_len - 1), not at T-1 — project only that position
+        x = lax.dynamic_slice_in_dim(x, jnp.asarray(last_index, jnp.int32), 1, axis=1)
+    elif last_only:
         x = x[:, -1:]
     if cfg.final_ln:
         x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layernorm_epsilon)
@@ -1087,6 +1136,40 @@ def apply_with_cache(
 # Loss
 # ---------------------------------------------------------------------------
 
+def effective_loss_impl(cfg: TransformerConfig, mesh=None, n_rows=None):
+    """Resolve the loss implementation that will ACTUALLY run -> (impl, reason).
+
+    One predicate shared by ``lm_loss_from_hidden`` (trace time) and the
+    engines (init time, via log_dist) so a silent fused→chunked fallback can
+    never diverge from what was reported. ``mesh`` defaults to the active
+    mesh; ``n_rows`` (= B*S) enables the shape-alignment check — pass None
+    for the shape-independent answer (engine init, before batches exist)."""
+    if cfg.loss_impl != "fused_xent":
+        return "chunked", "configured"
+    mesh = mesh if mesh is not None else _ACTIVE_MESH[0]
+    if mesh is not None and mesh.shape.get("model", 1) > 1:
+        # a vocab-sharded head under TP: pallas_call over the sharded head
+        # would force replication/all-gather of the full [D, V] head, silently
+        # defeating the kernel's HBM savings — keep the chunked einsum, which
+        # XLA partitions over the vocab shards
+        return "chunked", (
+            "tensor-parallel mesh (model axis > 1) shards the vocab head; "
+            "the fused_xent Pallas kernel cannot partition it — using the "
+            "chunked loss, which XLA partitions over the vocab shards"
+        )
+    if n_rows is not None:
+        br = cfg.loss_fused_block_rows or 128
+        bv = cfg.loss_fused_block_v or 128
+        if not (n_rows % 128 == 0 and n_rows % br == 0
+                and br % 128 == 0 and bv % 128 == 0):
+            return "chunked", (
+                f"rows (B*S={n_rows}) must be divisible by 128 and by "
+                f"loss_fused_block_rows ({cfg.loss_fused_block_rows or 'auto'}), "
+                f"with 128-aligned block_rows/block_v"
+            )
+    return "fused_xent", "configured"
+
+
 def lm_loss_from_hidden(cfg: TransformerConfig, params: Params, hidden, labels,
                         _top_streamed: bool = False) -> jnp.ndarray:
     """Token-mean next-token cross-entropy from final hidden states [B,S,d],
@@ -1101,22 +1184,16 @@ def lm_loss_from_hidden(cfg: TransformerConfig, params: Params, hidden, labels,
         head = stream(head)
 
     _n_rows = hidden.shape[0] * hidden.shape[1]
-    _br = cfg.loss_fused_block_rows or 128
-    _bv = cfg.loss_fused_block_v or 128
-    _fused_fits = (_n_rows % 128 == 0 and _n_rows % _br == 0
-                   and _br % 128 == 0 and _bv % 128 == 0)
-    if cfg.loss_impl == "fused_xent" and not _fused_fits:
+    _impl, _reason = effective_loss_impl(cfg, n_rows=_n_rows)
+    if cfg.loss_impl == "fused_xent" and _impl != "fused_xent":
         import warnings
 
         warnings.warn(
-            f"loss_impl='fused_xent' needs rows (B*S={_n_rows}) divisible by "
-            f"128 and by loss_fused_block_rows "
-            f"({cfg.loss_fused_block_rows or 'auto'}), and 128-aligned "
-            f"block_rows/block_v; falling back to the chunked loss — the "
-            "fused kernel's HBM savings do NOT apply",
+            f"loss_impl='fused_xent' falling back to the chunked loss "
+            f"({_reason}) — the fused kernel's HBM savings do NOT apply",
             stacklevel=2,
         )
-    if cfg.loss_impl == "fused_xent" and _fused_fits:
+    if _impl == "fused_xent":
         from ..ops.pallas.fused_xent import fused_linear_xent
 
         B, S, D = hidden.shape

@@ -22,6 +22,13 @@ This kernel:
 
 Layout: q [B, H, D] (the new token, post-rotary), k/v cache [B, Smax, H, D],
 pos [B] int32 = index of the newest valid entry (keys [0, pos] attended).
+
+The per-row ``pos`` vector is what makes the kernel continuous-batching
+ready: the serving engine's single compiled decode step
+(inference/serving.py) feeds one slot per batch row, each at its own
+absolute position — rows are never in lock-step, and a freshly admitted
+slot (small pos) streams only its own short prefix while a long-running
+neighbour streams its full one.
 """
 
 from __future__ import annotations

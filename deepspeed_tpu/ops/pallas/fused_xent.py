@@ -332,6 +332,13 @@ def fused_linear_xent(
     block_rows = block_rows or _auto_block(N, MAX_BLOCK_ROWS)
     if N % block_rows:
         raise ValueError(f"rows ({N}) must be divisible by block_rows ({block_rows})")
+    if block_rows % 8:
+        # TPU sublane tiling: a non-8-aligned row block fails Mosaic lowering
+        # on hardware with an obscure error — reject it here instead
+        raise ValueError(
+            f"block_rows ({block_rows}) must be a multiple of 8 (TPU sublane "
+            f"tile); pad rows to a multiple of 8 or pass an aligned block_rows"
+        )
     block_v = block_v or MAX_BLOCK_V
     if block_v % LANES:
         raise ValueError(f"block_v ({block_v}) must be a multiple of {LANES}")

@@ -156,6 +156,22 @@ def tree_shardings(mesh: Mesh, specs_tree):
     )
 
 
+def kv_slot_cache_spec(mesh: Mesh, n_slots: int, num_heads: int) -> PartitionSpec:
+    """PartitionSpec for the serving engine's persistent slot KV cache
+    [L, n_slots, Smax, H, Dh]: slots ride the ZeRO/data axes (each device
+    group owns a contiguous run of slots), heads ride the TP axis — XLA then
+    keeps decode-attention reads local to the shard that owns the slot. Any
+    mesh axis that does not divide its dim is dropped (replicated), mirroring
+    ``spec_from_logical``'s non-divisible rule."""
+    batch_axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    size = int(np.prod([mesh.shape[a] for a in batch_axes])) if batch_axes else 1
+    slot_axes = batch_axes if (batch_axes and size > 1 and n_slots % size == 0) else ()
+    model_size = mesh.shape.get("model", 1)
+    head_ax = "model" if (model_size > 1 and num_heads % model_size == 0) else None
+    slot = slot_axes if len(slot_axes) > 1 else (slot_axes[0] if slot_axes else None)
+    return PartitionSpec(None, slot, None, head_ax, None)
+
+
 def constrain(tree, mesh: Mesh, specs_tree):
     """with_sharding_constraint over a pytree (inside jit)."""
     flat_x, treedef = jax.tree.flatten(tree)

@@ -155,7 +155,7 @@ def pipeline_train_1f1b(
     trace) where trace = (is_fwd, fwd_mb, is_bwd, bwd_mb) each [S, ticks] for
     execution-order conformance tests against TrainSchedule.
     """
-    from jax import shard_map
+    from ..utils.jax_compat import shard_map
 
     M = x_mb.shape[0]
     S = num_stages

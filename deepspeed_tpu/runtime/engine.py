@@ -36,6 +36,7 @@ from .. import comm as dist
 from ..comm.mesh import MeshConfig, build_mesh, data_parallel_size
 from ..parallel import sharding as shd
 from ..ops.optimizers import get_optimizer
+from ..utils import jax_compat
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .config import DeepSpeedConfig
@@ -531,6 +532,18 @@ class DeepSpeedEngine:
         self._micro_count = 0
         self._eval_fn = None
 
+        mcfg = getattr(self.model, "config", None)
+        if getattr(mcfg, "loss_impl", None) is not None:
+            from ..models.transformer import effective_loss_impl
+
+            impl, reason = effective_loss_impl(mcfg, mesh=self.mesh)
+            note = "" if impl == mcfg.loss_impl else (
+                f" (configured {mcfg.loss_impl!r}: {reason})")
+            # surfaced HERE because the trace-time fallback warning inside the
+            # jitted loss can be deduplicated by the warnings filter and a
+            # run can silently train on the wrong path; shape-dependent
+            # alignment fallbacks still warn at trace time
+            log_dist(f"loss implementation: {impl}{note}", ranks=[0])
         n_params = sum(int(np.prod(s)) for s in jax.tree.leaves(shape_tree))
         log_dist(
             f"engine ready: {n_params/1e6:.1f}M params, zero_stage={zstage}, "
@@ -703,10 +716,10 @@ class DeepSpeedEngine:
                 # backend has one physical memory — align every operand's
                 # space abstractly
                 to_host = lambda t: jax.tree.map(
-                    lambda a: jax.device_put(a, jax.memory.Space.Host), t)
+                    lambda a: jax.device_put(a, jax_compat.memory_space("host")), t)
                 opt_in, master_in = to_host(opt_in), to_host(master_in)
                 finite_h, step1_h, lr_h = (
-                    jax.device_put(x, jax.memory.Space.Host)
+                    jax.device_put(x, jax_compat.memory_space("host"))
                     for x in (finite, step1, lr))
             else:
                 finite_h, step1_h, lr_h = finite, step1, lr
@@ -735,7 +748,7 @@ class DeepSpeedEngine:
         switches host-side at freeze_step (reference onebit/adam.py keeps
         the same host-side step counter): the frozen executable provably
         contains no fp32 gradient all-reduce."""
-        from jax import shard_map
+        from ..utils.jax_compat import shard_map
 
         cfg = self.config
         mesh = self.mesh
@@ -882,7 +895,7 @@ class DeepSpeedEngine:
         'frozen'/local NO gradient communication at all, 'frozen'/sync the
         1-bit accumulated-delta allreduce. ZeroOneClock picks the program
         host-side like the reference's interval counters."""
-        from jax import shard_map
+        from ..utils.jax_compat import shard_map
 
         from ..ops import zoadam as zo
 
@@ -1191,7 +1204,7 @@ class DeepSpeedEngine:
                     # host_add operands' spaces agree in the type system
                     zero_grads = jax.tree.map(
                         lambda p: jax.device_put(
-                            jnp.zeros(p.shape, jnp.float32), jax.memory.Space.Host),
+                            jnp.zeros(p.shape, jnp.float32), jax_compat.memory_space("host")),
                         params)
                 else:
                     zero_grads = jax.tree.map(
@@ -1217,10 +1230,10 @@ class DeepSpeedEngine:
                 )
             loss = loss_sum / gas
             if offp:
-                ls = jax.device_put(loss_scale, jax.memory.Space.Host)
+                ls = jax.device_put(loss_scale, jax_compat.memory_space("host"))
                 grads, finite, gnorm = finalize_grads(grads, ls)
-                finite = jax.device_put(finite, jax.memory.Space.Device)
-                gnorm = jax.device_put(gnorm, jax.memory.Space.Device)
+                finite = jax.device_put(finite, jax_compat.memory_space("device"))
+                gnorm = jax.device_put(gnorm, jax_compat.memory_space("device"))
             else:
                 grads = _tree_scale(grads, 1.0 / (loss_scale * gas))
                 flat = jax.tree.leaves(grads)

@@ -40,9 +40,12 @@ import jax
 PyTree = Any
 
 
+from ...utils.jax_compat import device_put_host, memory_space
+
+
 @jax.custom_vjp
 def _stream_leaf(x):
-    return jax.device_put(x, jax.memory.Space.Device)
+    return jax.device_put(x, memory_space("device"))
 
 
 def _fwd(x):
@@ -52,7 +55,7 @@ def _fwd(x):
 def _bwd(_, g):
     # gradient goes straight back to host: the [L, ...] cotangent stack the
     # scan transpose assembles must never live in HBM
-    return (jax.device_put(g, jax.memory.Space.Host),)
+    return (jax.device_put(g, memory_space("host")),)
 
 
 _stream_leaf.defvjp(_fwd, _bwd)
@@ -68,5 +71,5 @@ def stream_to_device(tree: PyTree) -> PyTree:
 def place_on_host(tree: PyTree) -> PyTree:
     """Host-level helper: commit a pytree to pinned host memory (identity in
     spirit on backends without a separate host space, e.g. the CPU test
-    backend, where Space.Host folds to device memory)."""
-    return jax.device_put(tree, jax.memory.Space.Host)
+    backend, where the host space folds to device memory)."""
+    return device_put_host(tree)

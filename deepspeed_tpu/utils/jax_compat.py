@@ -1,0 +1,77 @@
+"""Version-compat shims for jax APIs that moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace (~0.6) and renamed its replication-check kwarg
+(``check_rep`` -> ``check_vma``); importing the new spelling on jax 0.4.x
+raises ImportError and kills test collection. Import from here instead of
+either location — the wrapper also translates whichever check kwarg the
+caller used to the one the installed jax understands.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+_CHECK_KW = ("check_vma" if "check_vma" in _PARAMS
+             else "check_rep" if "check_rep" in _PARAMS else None)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, check_rep=None,
+              **kw):
+    flag = check_vma if check_vma is not None else check_rep
+    if flag is not None and _CHECK_KW is not None:
+        kw[_CHECK_KW] = flag
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def memory_space(kind: str):
+    """``jax.memory.Space.{Device,Host}`` (jax >= 0.7) or the 0.4.x
+    ``TransferToMemoryKind`` equivalent — valid as a ``device_put`` target
+    inside jit on both. ``kind``: "device" | "host"."""
+    import jax
+
+    if hasattr(jax, "memory"):
+        return jax.memory.Space.Host if kind == "host" else jax.memory.Space.Device
+    from jax._src.sharding_impls import TransferToMemoryKind
+
+    return TransferToMemoryKind("pinned_host" if kind == "host" else "device")
+
+
+def device_put_host(tree):
+    """Host-level (outside-jit) pinned-host placement of a pytree. On jax
+    0.4.x ``TransferToMemoryKind`` is jit-only, so each leaf falls back to
+    its own sharding with memory_kind="pinned_host"; backends without a
+    separate host space (the CPU test backend) keep the leaf where it is —
+    host RAM IS its memory."""
+    import jax
+
+    if hasattr(jax, "memory"):
+        return jax.device_put(tree, jax.memory.Space.Host)
+
+    def leaf(x):
+        try:
+            return jax.device_put(x, x.sharding.with_memory_kind("pinned_host"))
+        except (ValueError, AttributeError):
+            return x
+
+    return jax.tree.map(leaf, tree)
+
+
+def axis_size(axis):
+    """``lax.axis_size`` (added ~0.5) with the 0.4.x fallback: a psum of 1
+    over the axis, which constant-folds to the static size inside shard_map/
+    pmap contexts."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
+
+
+__all__ = ["shard_map", "axis_size", "memory_space", "device_put_host"]

@@ -5,11 +5,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu import comm
 from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 
 def test_mesh_shapes():

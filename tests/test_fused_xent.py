@@ -89,11 +89,59 @@ def test_engine_trains_with_fused_loss_dp_sharded():
     assert losses[-1] < losses[0], losses
 
 
+def test_fused_block_rows_alignment_rejected():
+    """Non-8-aligned block_rows fails Mosaic lowering on hardware with an
+    obscure error — the public entry must reject it with a clear one."""
+    h = jnp.zeros((100, 32), jnp.float32)
+    w = jnp.zeros((32, 256), jnp.float32)
+    y = jnp.zeros((100,), jnp.int32)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        # _auto_block(100) -> 100, not sublane-aligned
+        fused_linear_xent(h, w, y, interpret=True)
+
+
+def test_fused_falls_back_under_tp_mesh():
+    """A model-parallel mesh shards the vocab head; the loss must take the
+    chunked path (with the fallback warning) regardless of config discipline."""
+    import warnings
+
+    from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh
+    from deepspeed_tpu.models import transformer as tfm
+    from deepspeed_tpu.models.transformer import (
+        Model, TransformerConfig, effective_loss_impl)
+
+    cfg = TransformerConfig(vocab_size=256, hidden_size=64, num_layers=1,
+                            num_heads=4, max_seq_len=128,
+                            loss_impl="fused_xent",
+                            loss_fused_block_rows=128, loss_fused_block_v=128)
+    mesh = build_mesh(MeshConfig(data=-1, model=2))
+    impl, reason = effective_loss_impl(cfg, mesh=mesh)
+    assert impl == "chunked" and "model axis" in reason
+    model = Model(cfg)
+    model.set_mesh(mesh)
+    try:
+        params = model.init(jax.random.PRNGKey(0))
+        batch = {"tokens": jax.random.randint(jax.random.PRNGKey(1), (2, 129), 0, 256)}
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            loss = model.loss(params, batch)
+        assert np.isfinite(float(loss))
+        assert any("falling back to the chunked loss" in str(w.message) for w in rec)
+    finally:
+        tfm._ACTIVE_MESH[0] = None
+
+
 def test_model_loss_impl_fused_matches_chunked():
     """End-to-end: TransformerConfig(loss_impl='fused_xent') computes the same
     loss and parameter gradients as the chunked scan path."""
+    from deepspeed_tpu.models import transformer as tfm
     from deepspeed_tpu.models.transformer import (
         Model, TransformerConfig, causal_lm_loss)
+
+    # direct Model use (no engine): clear any TP mesh a previous test's
+    # engine left active, or effective_loss_impl's vocab-sharded-head guard
+    # would (correctly) force the chunked path and defeat this test
+    tfm._ACTIVE_MESH[0] = None
 
     base = dict(vocab_size=777, hidden_size=128, num_layers=2, num_heads=4,
                 max_seq_len=128, loss_chunk_size=64)
