@@ -16,8 +16,15 @@ token p50/p90, per-output-token latency p50/p90, and XLA compile counts (the
 mechanism behind the win). For the one-shot path TTFT is the request's full
 completion latency — it cannot stream, which is exactly the point.
 
+The continuous strategy additionally reports its telemetry registry view:
+TTFT/TPOT/queue-depth/slot-occupancy percentiles from the engine's
+log-bucketed histograms and the recompile watchdog's table (decode must show
+exactly 1 compilation). ``--jsonl PATH`` also streams the raw events
+(spans/compiles/requests/snapshot) for ``python -m
+deepspeed_tpu.telemetry.report PATH``.
+
 Usage:  JAX_PLATFORMS=cpu python benchmarks/serving_throughput.py
-            [--requests 10] [--slots 4] [--rate 4.0] [--seed 0]
+            [--requests 10] [--slots 4] [--rate 4.0] [--seed 0] [--jsonl PATH]
 Prints one JSON line.
 """
 
@@ -32,8 +39,9 @@ import numpy as np
 
 def _percentiles(xs):
     if not xs:
-        return {"p50": 0.0, "p90": 0.0}
-    return {"p50": float(np.percentile(xs, 50)), "p90": float(np.percentile(xs, 90))}
+        return {"p50": 0.0, "p90": 0.0, "p99": 0.0}
+    return {"p50": float(np.percentile(xs, 50)), "p90": float(np.percentile(xs, 90)),
+            "p99": float(np.percentile(xs, 99))}
 
 
 def _metrics(ttfts, tpots, total_tokens, makespan, compiles):
@@ -78,7 +86,30 @@ def run_continuous(serving, requests):
     tpots = [res.time_per_output_token for res in results.values()
              if len(res.tokens) > 1]
     total = sum(len(res.tokens) for res in results.values())
-    return _metrics(ttfts, tpots, total, makespan, serving.compile_counts())
+    out = _metrics(ttfts, tpots, total, makespan, serving.compile_counts())
+    # the engine's own telemetry: registry percentiles (TTFT/TPOT from the
+    # log-bucketed histograms, queue depth and slot occupancy per decode
+    # step) + the recompile table — the registry-side view of the same run
+    snap = serving.telemetry_snapshot()
+    hists = snap["metrics"]["histograms"]
+
+    def _hp(name):
+        h = hists.get(name, {})
+        return {q: h.get(q, 0.0) for q in ("p50", "p90", "p99")}
+
+    out["telemetry"] = {
+        "ttft_sec": _hp("serving/ttft_sec"),
+        "per_token_sec": _hp("serving/tpot_sec"),
+        "queue_depth": _hp("serving/queue_depth_hist"),
+        "slot_occupancy": _hp("serving/slot_occupancy"),
+        "decode_step_sec": _hp("serving/decode_step_sec"),
+        "counters": snap["metrics"]["counters"],
+        "recompile_table": [
+            {k: row[k] for k in ("name", "stable", "compiles", "total_compile_s")}
+            for row in snap["recompile_table"]
+        ],
+    }
+    return out
 
 
 def build_workload(n_requests, rate, seed, vocab):
@@ -109,6 +140,8 @@ def main():
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--rate", type=float, default=4.0, help="Poisson arrivals/sec")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--jsonl", default="", help="telemetry JSONL event log path "
+                    "(pretty-print with python -m deepspeed_tpu.telemetry.report)")
     args = ap.parse_args()
 
     import os
@@ -136,7 +169,8 @@ def main():
 
     seq = run_sequential(engine, requests)
     serving = ServingEngine(engine, n_slots=args.slots, max_seq_len=256,
-                            seed=args.seed)
+                            seed=args.seed,
+                            config={"jsonl_path": args.jsonl})
     cont = run_continuous(serving, requests)
 
     print(json.dumps({

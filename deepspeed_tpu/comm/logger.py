@@ -9,6 +9,7 @@ way ``comm.log_summary()`` does (comm/comm.py:461).
 
 from __future__ import annotations
 
+import warnings
 from collections import defaultdict
 
 import numpy as np
@@ -28,7 +29,7 @@ class CommsLogger:
     def __init__(self):
         self.enabled = False
         self.verbose = False
-        self.prof_ops: dict[str, dict] = defaultdict(lambda: {"count": 0, "bytes": 0})
+        self._ops: dict[str, dict] = defaultdict(lambda: {"count": 0, "bytes": 0})
 
     def configure(self, enabled: bool = False, verbose: bool = False, **_):
         self.enabled = enabled
@@ -38,19 +39,49 @@ class CommsLogger:
         if not self.enabled:
             return
         key = f"{op}@{axis}"
-        entry = self.prof_ops[key]
+        nbytes = _nbytes(tensor)
+        entry = self._ops[key]
         entry["count"] += 1
-        entry["bytes"] += _nbytes(tensor)
+        entry["bytes"] += nbytes
+        # volumes also land in the process-global metrics registry so one
+        # telemetry snapshot reports collectives next to step/latency metrics
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        reg.counter(f"comm/{key}/count").inc()
+        reg.counter(f"comm/{key}/bytes").inc(nbytes)
         if self.verbose:
-            logger.info(f"comm trace: {key} msg={_nbytes(tensor)}B")
+            logger.info(f"comm trace: {key} msg={nbytes}B")
+
+    @property
+    def prof_ops(self) -> dict[str, dict]:
+        """DEPRECATED: poke ``summary()`` (or a telemetry snapshot) instead
+        of this mutable internal store."""
+        warnings.warn(
+            "CommsLogger.prof_ops is deprecated; use CommsLogger.summary() "
+            "or the telemetry registry snapshot (comm/<op>@<axis>/{count,bytes})",
+            DeprecationWarning, stacklevel=2)
+        return self._ops
+
+    def summary(self) -> dict[str, dict]:
+        """Per-op trace-time totals: {"op@axis": {"count": n, "bytes": b}}."""
+        return {k: dict(v) for k, v in sorted(self._ops.items())}
 
     def log_all(self) -> None:
         logger.info("collective trace summary (per-compile counts):")
-        for key, entry in sorted(self.prof_ops.items()):
+        for key, entry in self.summary().items():
             logger.info(f"  {key}: count={entry['count']} volume={entry['bytes'] / 1e6:.2f} MB")
 
     def reset(self) -> None:
-        self.prof_ops.clear()
+        # the mirrored registry counters reset too, or the two views one
+        # snapshot reports (summary() vs comm/* counters) silently diverge
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        for key in self._ops:
+            reg.counter(f"comm/{key}/count").value = 0.0
+            reg.counter(f"comm/{key}/bytes").value = 0.0
+        self._ops.clear()
 
 
 comms_logger = CommsLogger()

@@ -56,6 +56,7 @@ from jax.sharding import NamedSharding
 
 from ..models import transformer as tfm
 from ..parallel.sharding import kv_slot_cache_spec
+from ..telemetry import Telemetry
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
 from .sampling import sample_logits_vector
@@ -129,11 +130,22 @@ class ServingEngine:
                           Default: the engine's sequence budget.
       min_prefill_bucket  smallest prompt bucket (power of two padding floor)
       seed                sampler PRNG seed
+      jsonl_path          telemetry JSONL event log ("" = off)
+      watchdog_mode       off|warn|raise when the compile-stable decode path
+                          compiles a second time (default warn)
+
+    Telemetry is always on (host-side dict updates per step — decode already
+    pays a device call): TTFT/TPOT histograms, queue depth, slot occupancy,
+    admissions/evictions, per-bucket prefill counts, and a recompile
+    watchdog over decode (stable: ONE program) and each prefill bucket.
+    ``telemetry_snapshot()`` reports everything in one call; pass
+    ``telemetry=`` to share a bundle across engines.
     """
 
     def __init__(self, engine: InferenceEngine, config: dict | None = None,
                  *, n_slots: int | None = None, max_seq_len: int | None = None,
-                 min_prefill_bucket: int | None = None, seed: int | None = None):
+                 min_prefill_bucket: int | None = None, seed: int | None = None,
+                 telemetry: Telemetry | None = None):
         config = dict(config or {})
         n_slots = n_slots if n_slots is not None else config.get("n_slots", 8)
         max_seq_len = max_seq_len if max_seq_len is not None else config.get(
@@ -141,6 +153,10 @@ class ServingEngine:
         min_prefill_bucket = (min_prefill_bucket if min_prefill_bucket is not None
                               else config.get("min_prefill_bucket", 16))
         seed = seed if seed is not None else config.get("seed", 0)
+        self.telemetry = telemetry if telemetry is not None else Telemetry(
+            jsonl_path=config.get("jsonl_path", ""),
+            watchdog_mode=config.get("watchdog_mode", "warn"),
+        )
 
         self.engine = engine
         self.cfg = engine.cfg
@@ -277,8 +293,14 @@ class ServingEngine:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :S] = prompt
             if bucket not in self._prefills:
-                self._prefills[bucket] = self._build_prefill(bucket)
+                # each bucket length is its own compile-stable program: one
+                # compile at first use, never again
+                wd = self.telemetry.watchdog
+                self._prefills[bucket] = wd.watch(
+                    self._build_prefill(bucket),
+                    wd.unique_name(f"serving/prefill[{bucket}]"), stable=True)
             self._rng, k = jax.random.split(self._rng)
+            t_pre = time.perf_counter()
             self._cache, tok = self._prefills[bucket](
                 self.params, self._cache, jnp.asarray(padded),
                 jnp.int32(slot), jnp.int32(S), k,
@@ -288,6 +310,16 @@ class ServingEngine:
             )
             first = int(np.asarray(jax.device_get(tok))[0])
             t_first = time.perf_counter() - self._epoch
+            tm = self.telemetry
+            # the token fetch above synced, so this wall time is device-true;
+            # the compiling call is excluded — compile/wall_s records it, and
+            # folding it in would make the latency tail pure compile time
+            if not self._prefills[bucket].last_call_compiled:
+                tm.histogram("serving/prefill_sec").observe(time.perf_counter() - t_pre)
+            tm.counter("serving/admissions").inc()
+            tm.counter(f"serving/prefill_bucket[{bucket}]").inc()
+            tm.histogram("serving/queue_wait_sec").observe(
+                max((t_pre - self._epoch) - req.arrival_time, 0.0))
             st = self._slots[slot]
             st.uid = req.uid
             st.remaining = req.max_new_tokens - 1
@@ -312,6 +344,20 @@ class ServingEngine:
         st.result.tokens = np.asarray(st.tokens, np.int32)
         st.result.finish_time = time.perf_counter() - self._epoch
         self._results[st.uid] = st.result
+        res = st.result
+        tm = self.telemetry
+        tm.counter("serving/evictions").inc()
+        tm.counter("serving/tokens_out").inc(len(res.tokens))
+        tm.histogram("serving/ttft_sec").observe(res.ttft)
+        tpot = res.time_per_output_token
+        if len(res.tokens) > 1:
+            tm.histogram("serving/tpot_sec").observe(tpot)
+        tm.emit({
+            "type": "request", "uid": res.uid, "slot": slot,
+            "prompt_len": res.prompt_len, "n_tokens": int(len(res.tokens)),
+            "ttft_s": res.ttft, "tpot_s": tpot,
+            "arrival_s": res.arrival_time, "finish_s": res.finish_time,
+        })
         self._slots[slot] = _Slot()
         self._active[slot] = False
         self._pos[slot] = 0  # park: decode writes for a free slot land at 0,
@@ -328,11 +374,24 @@ class ServingEngine:
         if now is None:
             now = time.perf_counter() - self._epoch
         self._admit(now)
+        tm = self.telemetry
+        tm.gauge("serving/queue_depth").set(len(self._queue))
         if not self._active.any():
             return []
         if self._decode is None:
-            self._decode = self._build_decode()
+            # THE compile-stable path: a second compilation here means an
+            # operand's shape/dtype/sharding drifted and every admission
+            # would pay a retrace — the watchdog warns or raises per config
+            wd = self.telemetry.watchdog
+            self._decode = wd.watch(
+                self._build_decode(), wd.unique_name("serving/decode"),
+                stable=True)
+        n_active = int(self._active.sum())
+        tm.gauge("serving/active_slots").set(n_active)
+        tm.histogram("serving/queue_depth_hist").observe(len(self._queue))
+        tm.histogram("serving/slot_occupancy").observe(n_active / self.n_slots)
         self._rng, k = jax.random.split(self._rng)
+        t_dec = time.perf_counter()
         self._cache, nxt = self._decode(
             self.params, self._cache, jnp.asarray(self._last_tok),
             jnp.asarray(self._pos), jnp.asarray(self._active), k,
@@ -341,6 +400,12 @@ class ServingEngine:
         )
         self._decode_steps += 1
         nxt = np.asarray(jax.device_get(nxt))
+        # nxt is fetched: the decode program has fully executed on device.
+        # The compiling call is excluded from the latency histogram (it is
+        # compile/wall_s's datum, and would otherwise be the p99)
+        if not self._decode.last_call_compiled:
+            tm.histogram("serving/decode_step_sec").observe(time.perf_counter() - t_dec)
+        tm.counter("serving/decode_steps").inc()
         finished = []
         for slot in range(self.n_slots):
             if not self._active[slot]:
@@ -396,3 +461,18 @@ class ServingEngine:
             "prefill": {b: int(f._cache_size()) for b, f in sorted(self._prefills.items())},
             "decode_steps": self._decode_steps,
         }
+
+    def telemetry_snapshot(self) -> dict:
+        """ONE call that reports everything: the metrics registry (TTFT/TPOT/
+        queue/occupancy histograms, admission/eviction/token counters), the
+        recompile table, the XLA program counts, and the trace-time
+        collective summary. Also appended to the JSONL log (type
+        ``snapshot``) when a sink is configured."""
+        from ..comm.logger import comms_logger
+
+        snap = self.telemetry.snapshot(
+            compiles=self.compile_counts(),
+            comm=comms_logger.summary(),
+        )
+        self.telemetry.emit({"type": "snapshot", **snap})
+        return snap

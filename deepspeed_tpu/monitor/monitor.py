@@ -55,24 +55,65 @@ class WandbMonitor(_Backend):
             self.wandb.log({tag: value}, step=step)
 
 
+def _close_handles(files: dict) -> None:
+    """Close every (handle, writer) value and empty the dict in place."""
+    for f, _ in files.values():
+        if not f.closed:
+            f.close()
+    files.clear()
+
+
 class CsvMonitor(_Backend):
+    """One CSV per tag, written through PERSISTENT per-tag handles.
+
+    The previous implementation reopened (and re-stat'ed) the file for every
+    single event — a monitored training loop paid an open/close syscall pair
+    per scalar per step. Handles now open once on a tag's first event and
+    stay open (writers cached alongside); one ``flush`` per ``write_events``
+    batch keeps the files tail-able without per-row flush cost.
+    """
+
     def __init__(self, cfg):
+        import weakref
+
         self.dir = os.path.join(cfg.output_path or "./csv_logs", cfg.job_name)
         os.makedirs(self.dir, exist_ok=True)
-        self.files = {}
+        self.files = {}  # filename -> (file handle, csv writer)
         self.enabled = True
+        # close handles at GC / interpreter exit without pinning the monitor
+        # alive (atexit on a bound method would leak every discarded
+        # instance's fds for the process lifetime). The finalizer holds the
+        # dict itself, so close() must clear it in place, never rebind it.
+        self._finalizer = weakref.finalize(self, _close_handles, self.files)
+
+    def _writer(self, tag):
+        # keyed by FILENAME, not tag: two tags that mangle to the same file
+        # ('a/b' and 'a_b') must share one handle or their buffered rows
+        # interleave and both write headers
+        fname = os.path.join(self.dir, tag.replace("/", "_") + ".csv")
+        entry = self.files.get(fname)
+        if entry is None:
+            import csv
+
+            new = not os.path.exists(fname) or os.path.getsize(fname) == 0
+            f = open(fname, "a", newline="")
+            w = csv.writer(f)
+            if new:
+                w.writerow(["step", tag])
+            entry = self.files[fname] = (f, w)
+        return entry
 
     def write_events(self, events):
-        import csv
-
+        touched = set()
         for tag, value, step in events:
-            fname = os.path.join(self.dir, tag.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", tag])
-                w.writerow([step, value])
+            f, w = self._writer(tag)
+            w.writerow([step, value])
+            touched.add(f)
+        for f in touched:
+            f.flush()
+
+    def close(self):
+        _close_handles(self.files)
 
 
 class MonitorMaster:
@@ -105,3 +146,8 @@ class MonitorMaster:
     def write_events(self, events):
         for b in self.backends:
             b.write_events(events)
+
+    def close(self):
+        for b in self.backends:
+            if hasattr(b, "close"):
+                b.close()

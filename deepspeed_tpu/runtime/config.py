@@ -192,6 +192,42 @@ class MonitorBackendConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Unified telemetry block (``deepspeed_tpu/telemetry/``; docs/observability.md).
+
+    The engine always keeps a per-instance metrics registry (host-side dict
+    updates, no device syncs); this block controls the exporters and the
+    recompile watchdog's response:
+
+    - ``enabled``: master switch for the exporters (JSONL sink + monitor
+      bridge). Metrics/compile accounting run regardless — they power
+      ``engine.telemetry_snapshot()``.
+    - ``jsonl_path``: append telemetry events (spans, compiles, snapshots)
+      here; pretty-print with ``python -m deepspeed_tpu.telemetry.report``.
+    - ``watchdog``: ``off | warn | raise`` — response when a compile-stable
+      path (serving decode) compiles a second time. The train step is
+      watched but never stable (curriculum/elastic batch shapes legitimately
+      retrace).
+    - ``device_sync_spans``: spans block on their attached output
+      (``jax.block_until_ready``) for device-accurate durations — defeats
+      async dispatch, profiling runs only.
+    - ``monitor_bridge``: forward registry snapshots into the MonitorMaster
+      backends at each print boundary.
+    """
+
+    enabled: bool = False
+    jsonl_path: str = ""
+    watchdog: str = "warn"
+    device_sync_spans: bool = False
+    monitor_bridge: bool = True
+
+    def __post_init__(self):
+        if self.watchdog not in ("off", "warn", "raise"):
+            raise DeepSpeedConfigError(
+                f"telemetry.watchdog must be off|warn|raise, got {self.watchdog!r}")
+
+
+@dataclass
 class CurriculumConfig:
     """reference: runtime/data_pipeline/curriculum_scheduler.py:8."""
 
@@ -318,6 +354,7 @@ class DeepSpeedConfig:
     tensorboard: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     curriculum_learning: CurriculumConfig = field(default_factory=CurriculumConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = field(default_factory=ProgressiveLayerDropConfig)
     eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
@@ -362,6 +399,7 @@ class DeepSpeedConfig:
             tensorboard=_build(MonitorBackendConfig, _sub(d, C.MONITOR_TENSORBOARD)),
             wandb=_build(MonitorBackendConfig, _sub(d, C.MONITOR_WANDB)),
             csv_monitor=_build(MonitorBackendConfig, _sub(d, C.MONITOR_CSV)),
+            telemetry=_build(TelemetryConfig, _sub(d, C.TELEMETRY)),
             curriculum_learning=_build(CurriculumConfig, _sub(d, C.CURRICULUM_LEARNING)),
             progressive_layer_drop=_build(ProgressiveLayerDropConfig, _sub(d, C.PROGRESSIVE_LAYER_DROP)),
             eigenvalue=_build(EigenvalueConfig, _sub(d, "eigenvalue")),

@@ -34,6 +34,7 @@ COMMS_LOGGER = "comms_logger"
 MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_WANDB = "wandb"
 MONITOR_CSV = "csv_monitor"
+TELEMETRY = "telemetry"
 CURRICULUM_LEARNING = "curriculum_learning"
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 ELASTICITY = "elasticity"

@@ -149,6 +149,25 @@ class DeepSpeedEngine:
             enabled=self.config.comms_logger.enabled, verbose=self.config.comms_logger.verbose
         )
 
+        # ---- telemetry spine (telemetry/; docs/observability.md) ------------
+        # The registry + watchdog always run (host-side dict updates; the
+        # compile table is how telemetry_snapshot() answers "what recompiled");
+        # config gates only the exporters: JSONL sink and monitor bridge.
+        from ..telemetry import MonitorBridge, Telemetry
+
+        tcfg = self.config.telemetry
+        self.telemetry = Telemetry(
+            jsonl_path=tcfg.jsonl_path if tcfg.enabled else "",
+            watchdog_mode=tcfg.watchdog,
+            device_sync_spans=tcfg.device_sync_spans,
+        )
+        self._telemetry_bridge = (
+            MonitorBridge(self.monitor)
+            if tcfg.enabled and tcfg.monitor_bridge and self.monitor.enabled
+            else None
+        )
+        self._last_seen_loss_scale = None  # boundary-sampled flip detection
+
         self._acknowledge_compiler_managed_knobs(raw)
         self._enforce_elasticity(raw)
 
@@ -1289,10 +1308,10 @@ class DeepSpeedEngine:
             return new_state, metrics
 
         if grads_only:
-            return jax.jit(
+            return self._watch_step(jax.jit(
                 train_step,
                 in_shardings=(self._state_shardings, NamedSharding(mesh, batch_spec)),
-            )
+            ))
         return self._jit_step(train_step, batch_spec)
 
     def _jit_step(self, train_step, batch_spec):
@@ -1326,7 +1345,15 @@ class DeepSpeedEngine:
             # first clean pass) so a host-memory leaf silently landing back
             # in device memory can't regress the offload savings unnoticed
             self._check_output_shardings = True
-        return jax.jit(train_step, **kwargs)
+        return self._watch_step(jax.jit(train_step, **kwargs))
+
+    def _watch_step(self, jitted):
+        """Register a built train-step program with the recompile watchdog.
+        The train path is watched but never ``stable``: curriculum/elastic
+        batch shapes legitimately retrace — the point is the compile table
+        (what compiled, when, how long), not a hard invariant."""
+        wd = self.telemetry.watchdog
+        return wd.watch(jitted, wd.unique_name("train/train_step"), stable=False)
 
     def _verify_state_shardings(self):
         """Per-step check (remat_offload mode only — output shardings are
@@ -1408,7 +1435,11 @@ class DeepSpeedEngine:
                 if sub in self.state
                 for path, leaf in jax.tree_util.tree_flatten_with_path(self.state[sub])[0]
             ]
-        self.state, metrics = self._train_step(self.state, batch)
+        with self.telemetry.span("train/train_batch") as _sp:
+            self.state, metrics = self._train_step(self.state, batch)
+            # dispatch-time span by default; device-accurate (blocks on the
+            # step's loss) when telemetry.device_sync_spans is set
+            _sp.set_sync(metrics["loss"])
         if donation_probe is not None:
             self._donation_checked = True
             if self.config.debug.nan_check:
@@ -1464,7 +1495,59 @@ class DeepSpeedEngine:
                     ("Train/Samples/lr", float(metrics["lr"]), self.global_samples),
                 ]
             )
+        self._train_telemetry(batch, metrics if need_host else None, _sp.dur_s)
         return metrics
+
+    def _train_telemetry(self, batch, metrics_host, step_dur: float) -> None:
+        """Per-step registry updates. Scalar gauges (loss/lr/grad-norm/scale)
+        and device-memory watermarks update only on host boundaries
+        (print/monitor steps) — between boundaries the step chain stays
+        fully async, the same contract train_batch itself keeps. Loss-scale
+        flips are therefore boundary-sampled: flips between two boundaries
+        collapse into one observed change."""
+        tm = self.telemetry
+        tm.histogram("train/step_time_sec").observe(step_dur)
+        tm.counter("train/steps").inc()
+        tm.counter("train/samples").inc(self.train_batch_size)
+        toks = batch.get("tokens") if isinstance(batch, dict) else None
+        if toks is not None and getattr(toks, "ndim", 0) >= 2:
+            tm.counter("train/tokens").inc(int(toks.shape[0]) * int(toks.shape[1]))
+        if metrics_host is None:
+            return
+        tm.gauge("train/loss").set(float(metrics_host["loss"]))
+        tm.gauge("train/lr").set(float(metrics_host["lr"]))
+        tm.gauge("train/grad_norm").set(float(metrics_host["grad_norm"]))
+        scale = float(metrics_host["loss_scale"])
+        tm.gauge("train/loss_scale").set(scale)
+        if self._last_seen_loss_scale is not None and scale != self._last_seen_loss_scale:
+            tm.counter("train/loss_scale_flips").inc()
+        self._last_seen_loss_scale = scale
+        if bool(np.asarray(metrics_host["overflow"])):
+            tm.counter("train/overflow_steps").inc()
+        from ..utils.memory import device_memory_stats
+
+        stats = device_memory_stats()
+        if stats:
+            tm.gauge("train/device_bytes_in_use").set(stats.get("bytes_in_use", 0))
+            tm.gauge("train/device_peak_bytes").set(stats.get("peak_bytes_in_use", 0))
+        # bridge pushes only at print boundaries (the documented contract):
+        # with a monitor enabled, metrics land on host EVERY step, but a
+        # full snapshot fan-out per step would put O(metrics) backend writes
+        # on the hot path
+        if (self._telemetry_bridge is not None
+                and self.global_steps % self.config.steps_per_print == 0):
+            self._telemetry_bridge.push(tm.registry, self.global_steps)
+
+    def telemetry_snapshot(self) -> dict:
+        """ONE call that reports everything: registry metrics (step-time
+        histogram, throughput counters, boundary gauges, memory watermarks),
+        the compile table, and the trace-time collective summary. Appended
+        to the JSONL log (type ``snapshot``) when a sink is configured."""
+        from ..comm.logger import comms_logger
+
+        snap = self.telemetry.snapshot(comm=comms_logger.summary())
+        self.telemetry.emit({"type": "snapshot", **snap})
+        return snap
 
     def _run_flops_profiler(self, batch):
         """flops_profiler config block (reference engine.py:1608-1627: print
@@ -1500,6 +1583,7 @@ class DeepSpeedEngine:
         if self.curriculum_scheduler is not None:
             batch = self._apply_curriculum(batch)
         self.tput_timer.start()
+        t_step = time.perf_counter()
         grads, metrics = self._train_step(self.state, batch)
         metrics = jax.device_get(metrics)
         overflow = bool(np.asarray(metrics["overflow"]))
@@ -1534,6 +1618,9 @@ class DeepSpeedEngine:
                 ("Train/Samples/lr", float(metrics["lr"]), self.global_samples),
             ]
         )
+        # the NVMe path is synchronous (per-step host Adam): metrics are
+        # already on host, so the gauges update every step
+        self._train_telemetry(batch, metrics, time.perf_counter() - t_step)
         return metrics
 
     def _maybe_quantize_weights(self):

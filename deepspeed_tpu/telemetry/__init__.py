@@ -1,0 +1,85 @@
+"""Unified telemetry: metrics registry + span tracing + recompile watchdog +
+exporters.
+
+One spine for "what is slow, what recompiled, and what is each request
+experiencing" (SURVEY §5 observability; the reference's MonitorMaster /
+CommsLogger / nvtx / flops-profiler islands, unified):
+
+  * ``MetricsRegistry`` — counters, gauges, log-bucketed histograms with
+    p50/p90/p99 estimates, cheap enough for per-decode-step updates.
+  * ``SpanTracer`` — nested host spans that also open
+    ``jax.profiler.TraceAnnotation`` ranges (JSONL + XPlane, one API).
+  * ``RecompileWatchdog`` — wraps jitted entry points; every compilation is
+    an event; paths declared compile-stable (serving decode) warn/raise on a
+    second compilation.
+  * exporters — JSONL event log, Prometheus text, MonitorMaster bridge.
+
+``Telemetry`` bundles the four with one config surface; engines hold one
+instance each. Metric names follow ``subsystem/name``
+(docs/observability.md is the catalog).
+"""
+
+from .exporters import JsonlExporter, MonitorBridge, prometheus_text
+from .registry import Counter, Gauge, Histogram, MetricsRegistry, get_registry
+from .tracing import Span, SpanTracer
+from .watchdog import RecompileError, RecompileWatchdog, abstract_signature
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "Span", "SpanTracer", "RecompileError", "RecompileWatchdog",
+    "abstract_signature", "JsonlExporter", "MonitorBridge", "prometheus_text",
+    "Telemetry",
+]
+
+
+class Telemetry:
+    """One registry + tracer + watchdog + optional JSONL sink.
+
+    ``registry=None`` creates a private registry (engine-scoped metrics
+    should not mix across engine instances); pass ``get_registry()`` to
+    share the process-global one instead.
+    """
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 jsonl_path: str = "", watchdog_mode: str = "warn",
+                 device_sync_spans: bool = False):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.sink = JsonlExporter(jsonl_path) if jsonl_path else None
+        self.tracer = SpanTracer(self.registry, self.sink,
+                                 device_sync=device_sync_spans)
+        self.watchdog = RecompileWatchdog(self.registry, self.sink,
+                                          mode=watchdog_mode)
+
+    # convenience passthroughs — instrumented code holds one handle
+    def counter(self, name: str) -> Counter:
+        return self.registry.counter(name)
+
+    def gauge(self, name: str) -> Gauge:
+        return self.registry.gauge(name)
+
+    def histogram(self, name: str) -> Histogram:
+        return self.registry.histogram(name)
+
+    def span(self, name: str, sync=None, **attrs):
+        return self.tracer.span(name, sync=sync, **attrs)
+
+    def watch(self, fn, name: str, stable: bool = False):
+        return self.watchdog.watch(fn, name, stable=stable)
+
+    def emit(self, event: dict) -> None:
+        if self.sink is not None:
+            self.sink.emit(event)
+
+    def snapshot(self, **extra) -> dict:
+        """Registry snapshot + recompile table (+ caller extras), the one
+        call that reports everything."""
+        out = {
+            "metrics": self.registry.snapshot(),
+            "recompile_table": self.watchdog.compile_table(),
+        }
+        out.update(extra)
+        return out
+
+    def close(self) -> None:
+        if self.sink is not None:
+            self.sink.close()

@@ -1,0 +1,118 @@
+"""Telemetry exporters: JSONL event log, Prometheus text dump, MonitorMaster
+bridge.
+
+Three consumers, three shapes:
+
+  * ``JsonlExporter`` — append-only event stream (spans, compiles, requests,
+    registry snapshots) for offline triage; ``python -m
+    deepspeed_tpu.telemetry.report run.jsonl`` pretty-prints it.
+  * ``prometheus_text`` — point-in-time scrape body in the Prometheus text
+    exposition format (counters as ``_total``, histogram quantiles as
+    ``{quantile="0.5"}`` labels) for a sidecar to serve.
+  * ``MonitorBridge`` — flattens a registry snapshot into the existing
+    ``MonitorMaster`` ``(tag, value, step)`` event fan-out so TensorBoard /
+    W&B / CSV backends receive telemetry without new plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from .registry import MetricsRegistry
+
+
+class JsonlExporter:
+    """Append telemetry events to a JSONL file, one object per line.
+
+    Every event gets an absolute wall-clock ``"t"`` stamp at emit time.
+    Writes are locked (spans may close from helper threads) and flushed per
+    emit — event rates here are per-step/per-request, not per-token, so
+    durability beats batching.
+    """
+
+    def __init__(self, path: str):
+        import weakref
+
+        self.path = path
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+        # engines have no destroy() hook; a weakref finalizer closes the fd
+        # at GC or interpreter exit WITHOUT pinning the exporter alive the
+        # way atexit.register(bound method) would
+        self._finalizer = weakref.finalize(self, JsonlExporter._close_file, self._f)
+
+    @staticmethod
+    def _close_file(f) -> None:
+        if not f.closed:
+            f.close()
+
+    def emit(self, event: dict) -> None:
+        line = json.dumps({"t": time.time(), **event}, separators=(",", ":"),
+                          default=str)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            JsonlExporter._close_file(self._f)
+
+
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    return "dstpu_" + "".join(out)
+
+
+def prometheus_text(registry: MetricsRegistry) -> str:
+    """Prometheus text exposition of a registry snapshot."""
+    snap = registry.snapshot()
+    lines = []
+    for name, v in snap["counters"].items():
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn}_total counter")
+        lines.append(f"{pn}_total {v}")
+    for name, v in snap["gauges"].items():
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {v}")
+    for name, h in snap["histograms"].items():
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} summary")
+        for q in ("p50", "p90", "p99"):
+            lines.append(f'{pn}{{quantile="0.{q[1:]}"}} {h[q]}')
+        lines.append(f"{pn}_sum {h['sum']}")
+        lines.append(f"{pn}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+class MonitorBridge:
+    """Forward registry snapshots into ``MonitorMaster`` backends.
+
+    Counters and gauges become one event each; histograms fan out to
+    ``<tag>/p50|p90|p99``. Tags are ``<prefix>/<metric name>`` — the
+    ``subsystem/name`` scheme nests naturally under TensorBoard groups.
+    """
+
+    def __init__(self, monitor, prefix: str = "Telemetry"):
+        self.monitor = monitor
+        self.prefix = prefix
+
+    def push(self, registry: MetricsRegistry, step: int) -> list:
+        """Build and deliver the event batch; returns it (for tests/logs)."""
+        snap = registry.snapshot()
+        events = []
+        for name, v in snap["counters"].items():
+            events.append((f"{self.prefix}/{name}", v, step))
+        for name, v in snap["gauges"].items():
+            events.append((f"{self.prefix}/{name}", v, step))
+        for name, h in snap["histograms"].items():
+            for q in ("p50", "p90", "p99"):
+                events.append((f"{self.prefix}/{name}/{q}", h[q], step))
+        if events and getattr(self.monitor, "enabled", False):
+            self.monitor.write_events(events)
+        return events

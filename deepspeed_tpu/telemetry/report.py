@@ -1,0 +1,159 @@
+"""Run-summary CLI over a telemetry JSONL event log.
+
+    python -m deepspeed_tpu.telemetry.report run.jsonl [--top 10]
+
+Pretty-prints, for CI logs and bench triage:
+
+  * top spans by total time (count / total / mean / max per span path),
+  * the recompile table (per watched path: compiles, compile seconds, the
+    signatures that triggered them) with stable-path violations flagged,
+  * request latency percentiles (TTFT / per-output-token) from ``request``
+    events,
+  * the last registry ``snapshot`` event, if the run emitted one.
+
+Pure stdlib + host-side: safe to run anywhere the JSONL landed (no jax
+import, no device).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(path: str) -> list[dict]:
+    events = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"warning: {path}:{ln}: unparseable line skipped",
+                      file=sys.stderr)
+    return events
+
+
+def _pct(sorted_xs: list[float], q: float) -> float:
+    if not sorted_xs:
+        return 0.0
+    idx = min(int(q * (len(sorted_xs) - 1) + 0.5), len(sorted_xs) - 1)
+    return sorted_xs[idx]
+
+
+def _fmt_s(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:.2f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s * 1e6:.0f}us"
+
+
+def summarize(events: list[dict], top: int = 10) -> str:
+    lines = []
+
+    # -- spans ----------------------------------------------------------
+    spans = defaultdict(lambda: {"count": 0, "total": 0.0, "max": 0.0})
+    for ev in events:
+        if ev.get("type") == "span":
+            agg = spans[ev["path"]]
+            agg["count"] += 1
+            agg["total"] += ev["dur_s"]
+            agg["max"] = max(agg["max"], ev["dur_s"])
+    if spans:
+        lines.append(f"top spans by total time ({len(spans)} distinct):")
+        lines.append(f"  {'path':<40} {'count':>7} {'total':>10} {'mean':>10} {'max':>10}")
+        ranked = sorted(spans.items(), key=lambda kv: -kv[1]["total"])[:top]
+        for path, agg in ranked:
+            lines.append(
+                f"  {path:<40} {agg['count']:>7} {_fmt_s(agg['total']):>10} "
+                f"{_fmt_s(agg['total'] / agg['count']):>10} {_fmt_s(agg['max']):>10}")
+        lines.append("")
+
+    # -- recompiles -----------------------------------------------------
+    compiles = defaultdict(lambda: {"n": 0, "total_s": 0.0, "sigs": []})
+    refusals = defaultdict(int)
+    for ev in events:
+        if ev.get("type") == "compile":
+            agg = compiles[ev["name"]]
+            agg["n"] += 1
+            agg["total_s"] += ev.get("compile_s", 0.0)
+            agg["sigs"].append(ev.get("signature", "?"))
+        elif ev.get("type") == "refusal":
+            refusals[ev["name"]] = max(refusals[ev["name"]], ev.get("n_refused", 1))
+    if compiles or refusals:
+        total_s = sum(a["total_s"] for a in compiles.values())
+        lines.append(f"recompile table ({sum(a['n'] for a in compiles.values())} "
+                     f"compilations, {_fmt_s(total_s)} total):")
+        lines.append(f"  {'path':<40} {'compiles':>8} {'wall':>10}  signature(s)")
+        for name in sorted(set(compiles) | set(refusals),
+                           key=lambda n: -compiles[n]["total_s"]):
+            agg = compiles[name]
+            sig = agg["sigs"][-1] if agg["sigs"] else "?"
+            if len(sig) > 60:
+                sig = sig[:57] + "..."
+            flag = "  <-- RECOMPILED" if agg["n"] > 1 else ""
+            if refusals.get(name):
+                flag += f"  [{refusals[name]} refused pre-exec]"
+            lines.append(f"  {name:<40} {agg['n']:>8} {_fmt_s(agg['total_s']):>10}  {sig}{flag}")
+        lines.append("")
+
+    # -- requests -------------------------------------------------------
+    ttfts = sorted(ev["ttft_s"] for ev in events
+                   if ev.get("type") == "request" and "ttft_s" in ev)
+    tpots = sorted(ev["tpot_s"] for ev in events
+                   if ev.get("type") == "request" and ev.get("tpot_s", 0) > 0)
+    if ttfts:
+        lines.append(f"request latency ({len(ttfts)} requests):")
+        lines.append(
+            f"  ttft     p50={_fmt_s(_pct(ttfts, .5))} p90={_fmt_s(_pct(ttfts, .9))} "
+            f"p99={_fmt_s(_pct(ttfts, .99))}")
+        if tpots:
+            lines.append(
+                f"  per-tok  p50={_fmt_s(_pct(tpots, .5))} p90={_fmt_s(_pct(tpots, .9))} "
+                f"p99={_fmt_s(_pct(tpots, .99))}")
+        lines.append("")
+
+    # -- last snapshot --------------------------------------------------
+    snap = None
+    for ev in events:
+        if ev.get("type") == "snapshot":
+            snap = ev
+    if snap is not None:
+        metrics = snap.get("metrics", {})
+        lines.append("last registry snapshot:")
+        for name, v in metrics.get("counters", {}).items():
+            lines.append(f"  {name:<44} {v:g}")
+        for name, v in metrics.get("gauges", {}).items():
+            lines.append(f"  {name:<44} {v:g}")
+        for name, h in metrics.get("histograms", {}).items():
+            # only time-suffixed metrics render with time units
+            timed = name.endswith(("_sec", "_s")) or name.startswith("span/")
+            fmt = _fmt_s if timed else (lambda v: f"{v:g}")
+            lines.append(
+                f"  {name:<44} n={h['count']} p50={fmt(h['p50'])} "
+                f"p90={fmt(h['p90'])} p99={fmt(h['p99'])}")
+        lines.append("")
+
+    if not lines:
+        lines.append("no telemetry events found")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry.report",
+        description="Pretty-print a telemetry JSONL run summary.")
+    ap.add_argument("jsonl", help="path to the telemetry JSONL event log")
+    ap.add_argument("--top", type=int, default=10, help="span rows to show")
+    args = ap.parse_args(argv)
+    print(summarize(load_events(args.jsonl), top=args.top), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,141 @@
+"""Host-side span tracer that nests with ``jax.profiler.TraceAnnotation``.
+
+A span is a named wall-clock region. Each span:
+
+  * opens a ``TraceAnnotation`` so the same region appears in XPlane traces
+    (TensorBoard / Perfetto) when a profiler session is active — the NVTX
+    role the reference's ``instrument_w_nvtx`` plays (utils/nvtx.py);
+  * feeds its duration into the registry histogram ``span/<path>`` where
+    ``path`` is the slash-joined nesting (``serve/step/decode``);
+  * optionally emits a JSONL event ``{"type": "span", "name", "path",
+    "depth", "start_s", "dur_s"}`` (``start_s`` relative to the tracer's
+    epoch, ``t`` absolute wall time added by the exporter).
+
+Device-accurate mode: dispatch is async under JAX, so a span that merely
+brackets a ``jit`` call times the *dispatch*. Instrumented code attaches the
+step's output via ``span.set_sync(x)`` (or the ``sync=`` argument); a tracer
+built with ``device_sync=True`` then blocks on it at exit via
+``jax.block_until_ready`` — the CUDA-event analogue on TPU. With
+``device_sync=False`` (default) the attached value is ignored and spans time
+dispatch only, so instrumentation never costs a sync unless asked to.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import jax
+
+from .registry import MetricsRegistry, get_registry
+
+
+class Span:
+    """One open region. Use via ``SpanTracer.span`` (context manager)."""
+
+    __slots__ = ("name", "path", "depth", "start_s", "dur_s", "attrs", "_sync", "_ann")
+
+    def __init__(self, name: str, path: str, depth: int):
+        self.name = name
+        self.path = path
+        self.depth = depth
+        self.start_s = 0.0
+        self.dur_s = 0.0
+        self.attrs: dict = {}
+        self._sync = None
+        self._ann = None
+
+    def set_sync(self, value) -> None:
+        """Arrange for the span to block on ``value`` (any array/pytree) at
+        exit, making its duration device-accurate."""
+        self._sync = value
+
+    def annotate(self, **attrs) -> None:
+        """Attach extra key/values to the span's JSONL event."""
+        self.attrs.update(attrs)
+
+
+class SpanTracer:
+    def __init__(self, registry: Optional[MetricsRegistry] = None, sink=None,
+                 device_sync: bool = False):
+        self.registry = registry if registry is not None else get_registry()
+        self.sink = sink
+        self.device_sync = device_sync
+        self._tls = threading.local()
+        self._epoch = time.perf_counter()
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def span(self, name: str, sync=None, **attrs) -> "_SpanCtx":
+        """Open a nested span: ``with tracer.span("decode") as sp: ...``.
+
+        ``sync``: optional value to block on at exit. Blocking only happens
+        when the tracer was built with ``device_sync=True`` — instrumented
+        code can attach sync values unconditionally and the config knob
+        decides whether spans pay the device round-trip.
+        """
+        return _SpanCtx(self, name, sync, attrs)
+
+    def _emit(self, span: Span) -> None:
+        self.registry.histogram(f"span/{span.path}").observe(span.dur_s)
+        if self.sink is not None:
+            ev = {
+                "type": "span",
+                "name": span.name,
+                "path": span.path,
+                "depth": span.depth,
+                "start_s": round(span.start_s, 6),
+                "dur_s": span.dur_s,
+            }
+            if span.attrs:
+                ev.update(span.attrs)
+            self.sink.emit(ev)
+
+
+class _SpanCtx:
+    __slots__ = ("tracer", "name", "sync", "attrs", "span")
+
+    def __init__(self, tracer: SpanTracer, name: str, sync, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.sync = sync
+        self.attrs = attrs
+
+    def __enter__(self) -> Span:
+        stack = self.tracer._stack()
+        parent = stack[-1] if stack else None
+        path = f"{parent.path}/{self.name}" if parent else self.name
+        sp = Span(self.name, path, len(stack))
+        if self.attrs:
+            sp.attrs.update(self.attrs)
+        if self.sync is not None:
+            sp._sync = self.sync
+        sp._ann = jax.profiler.TraceAnnotation(sp.path)
+        sp._ann.__enter__()
+        stack.append(sp)
+        sp.start_s = time.perf_counter() - self.tracer._epoch
+        self.span = sp
+        return sp
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        sp = self.span
+        sync = sp._sync
+        try:
+            # a failing async computation surfaces HERE in device_sync mode —
+            # the annotation/stack cleanup below must still run or every
+            # later span on this thread inherits a corrupted nesting path
+            if exc_type is None and sync is not None and self.tracer.device_sync:
+                jax.block_until_ready(sync)
+        finally:
+            sp.dur_s = (time.perf_counter() - self.tracer._epoch) - sp.start_s
+            sp._ann.__exit__(exc_type, exc, tb)
+            stack = self.tracer._stack()
+            if stack and stack[-1] is sp:
+                stack.pop()
+        if exc_type is None:
+            self.tracer._emit(sp)

@@ -227,10 +227,13 @@ def test_pld_and_sparse_attention_config_blocks_reach_model():
     mc = engine.model.config
     assert mc.pld_enabled and mc.pld_theta == 0.6 and mc.pld_gamma == 0.002
     assert mc.attn_impl == "sparse" and mc.sparsity["mode"] == "fixed"
-    # and the resulting engine still trains (sparse kernel path, 64-seq)
-    batch = {"tokens": np.random.default_rng(0).integers(0, 128, (16, 65)).astype(np.int32)}
-    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(3)]
-    assert np.isfinite(losses).all()
+    # and the resulting engine still trains on the sparse kernel path. Kept
+    # deliberately small (32-seq, 1 step): the interpret-mode sparse kernel
+    # executes ~seq^2-slow on CPU and this single test was 128s of the tier-1
+    # budget at 64-seq/3-steps — the config-plumbing + trains contract
+    # (finite loss through sparse fwd/bwd/update) is identical at this size
+    batch = {"tokens": np.random.default_rng(0).integers(0, 128, (16, 33)).astype(np.int32)}
+    assert np.isfinite(float(engine.train_batch(batch)["loss"]))
 
 
 def test_save_16bit_model_and_consolidated_state_dict(tmp_path):

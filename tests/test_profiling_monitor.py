@@ -124,14 +124,31 @@ def test_comms_logger_records_trace_time():
             in_specs=P("data"), out_specs=P(), check_vma=False,
         )
         jax.jit(f)(jnp.ones((8, 4)))
-        keys = list(comms_logger.prof_ops)
+        summ = comms_logger.summary()
+        keys = list(summ)
         assert any("all_reduce" in k for k in keys), keys
-        rec = comms_logger.prof_ops[[k for k in keys if "all_reduce" in k][0]]
+        rec = summ[[k for k in keys if "all_reduce" in k][0]]
         assert rec["count"] >= 1 and rec["bytes"] > 0
+        # deprecated mutable-store access still works but warns
+        import pytest as _pytest
+
+        with _pytest.warns(DeprecationWarning):
+            assert comms_logger.prof_ops
+        # volumes also routed into the global telemetry registry
+        from deepspeed_tpu.telemetry import get_registry
+
+        snap = get_registry().snapshot()
+        assert any(k.startswith("comm/all_reduce") and k.endswith("/bytes")
+                   and v > 0 for k, v in snap["counters"].items()), snap["counters"]
         comms_logger.log_all()  # must not raise
     finally:
         comms_logger.configure(enabled=False, verbose=False)
         comms_logger.reset()
+    # reset keeps both views consistent: internal store AND mirrored counters
+    assert comms_logger.summary() == {}
+    snap2 = get_registry().snapshot()
+    assert all(v == 0 for k, v in snap2["counters"].items()
+               if k.startswith("comm/")), snap2["counters"]
 
 
 def test_env_report_runs():
