@@ -220,19 +220,32 @@ def _run_child(extra_env, timeout):
 
 
 def _parent():
-    diag = {"preflight": None, "attempts": []}
+    diag = {"preflight": None, "attempts": [], "preflight_attempts": 0}
 
     def emit(line, stage):
         obj = json.loads(line)
         obj["bench_stage"] = stage
         if diag["preflight"]:
             obj["preflight_s"] = diag["preflight"].get("elapsed_s")
+        obj["preflight_attempts"] = diag["preflight_attempts"]
         print(json.dumps(obj), flush=True)
         return 0
 
-    def note(stage, err):
+    def _collapse(attempts):
+        """['preflight: timeout', 'preflight: timeout'] -> one entry with a
+        count — the r05 log tail was N identical lines saying nothing new."""
+        out = []
+        for a in attempts:
+            if out and out[-1][0] == a:
+                out[-1][1] += 1
+            else:
+                out.append([a, 1])
+        return [(a if n == 1 else f"{a} (x{n})") for a, n in out]
+
+    def note(stage, err, collapse_stderr=False):
         diag["attempts"].append(f"{stage}: {err}")
-        print(f"[bench] {stage} failed: {err}", file=sys.stderr, flush=True)
+        if not collapse_stderr:
+            print(f"[bench] {stage} failed: {err}", file=sys.stderr, flush=True)
 
     timeouts = tuple(
         int(t) for t in os.environ.get(
@@ -241,10 +254,15 @@ def _parent():
     pf_timeout, full_timeout, retry_timeout, fb_timeout = (tuple(timeouts) + (600,) * 4)[:4]
 
     # 1. backend pre-flight: tiny jit on a short deadline, one retry.
+    # Failed attempts are collected and printed as ONE collapsed stderr line
+    # after the loop (repeating "[bench] preflight failed: timeout" per
+    # attempt added nothing — BENCH_r05's tail was the same line twice).
     backend_up = False
+    pf_errs = []
     for attempt in range(2):
         if attempt:
             time.sleep(30)
+        diag["preflight_attempts"] = attempt + 1
         line, err = _run_child({_MODE_ENV: "preflight"}, timeout=pf_timeout)
         if line:
             diag["preflight"] = json.loads(line)
@@ -252,7 +270,13 @@ def _parent():
             if not backend_up:
                 note("preflight", f"came up on {diag['preflight'].get('platform')}")
             break
-        note("preflight", err)
+        pf_errs.append(err)
+        note("preflight", err, collapse_stderr=True)
+    if pf_errs:
+        msgs = _collapse(pf_errs)
+        print(f"[bench] preflight failed ({len(pf_errs)} attempt"
+              f"{'s' if len(pf_errs) > 1 else ''}): " + "; ".join(msgs),
+              file=sys.stderr, flush=True)
 
     if backend_up:
         # 2. full tuned config (+1 retry — transient tunnel drops happen)
@@ -278,7 +302,8 @@ def _parent():
         obj["diagnosis"] = (
             "tpu backend/tunnel down (preflight failed)" if not backend_up
             else "tpu bench failed despite live backend — code regression?")
-        obj["errors"] = "; ".join(diag["attempts"])[-500:]
+        obj["errors"] = "; ".join(_collapse(diag["attempts"]))[-500:]
+        obj["preflight_attempts"] = diag["preflight_attempts"]
         print(json.dumps(obj), flush=True)
         return 0
     note("cpu", err)
@@ -287,7 +312,8 @@ def _parent():
         "value": 0.0,
         "unit": "TFLOPS/chip",
         "vs_baseline": 0.0,
-        "error": "; ".join(diag["attempts"])[-500:],
+        "error": "; ".join(_collapse(diag["attempts"]))[-500:],
+        "preflight_attempts": diag["preflight_attempts"],
     }), flush=True)
     return 0
 

@@ -23,8 +23,17 @@ exactly 1 compilation). ``--jsonl PATH`` also streams the raw events
 (spans/compiles/requests/snapshot) for ``python -m
 deepspeed_tpu.telemetry.report PATH``.
 
+``--workload shared_prefix`` instead replays the prompt-side worst case the
+prefix cache + chunked prefill exist for: N requests sharing one
+``--prefix-len``-token system prompt with unique tails, run through the
+continuous engine with the feature matrix OFF and ON (same workload, same
+params). Reported per cell: TTFT p50/p99, aggregate tokens/sec, decode-step
+latency, and (ON) the prefix-cache stats — the JSON line records the matrix
+so a regression in either feature is attributable.
+
 Usage:  JAX_PLATFORMS=cpu python benchmarks/serving_throughput.py
             [--requests 10] [--slots 4] [--rate 4.0] [--seed 0] [--jsonl PATH]
+            [--workload ragged|shared_prefix] [--prefix-len 512]
 Prints one JSON line.
 """
 
@@ -35,6 +44,12 @@ import json
 import time
 
 import numpy as np
+
+
+def _next_seq(n):
+    """Round a sequence requirement up to a multiple of 128 (slot-cache
+    allocation granularity — keeps max_seq_len == Smax, no wasted tail)."""
+    return -(-n // 128) * 128
 
 
 def _percentiles(xs):
@@ -134,6 +149,116 @@ def build_workload(n_requests, rate, seed, vocab):
     return reqs
 
 
+def build_shared_prefix_workload(n_requests, rate, seed, vocab, prefix_len):
+    """N requests x one common ``prefix_len``-token system prompt + unique
+    8-48 token tails; Poisson arrivals; all greedy (the feature-matrix cells
+    must be token-comparable, and greedy parity is the engines' contract)."""
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_requests))
+    shared = rng.integers(0, vocab, size=prefix_len).astype(np.int32)
+    from deepspeed_tpu.inference import Request
+
+    reqs = []
+    for i in range(n_requests):
+        tail = rng.integers(0, vocab, size=int(rng.integers(8, 49))).astype(np.int32)
+        reqs.append(Request(
+            uid=i,
+            prompt=np.concatenate([shared, tail]),
+            max_new_tokens=int(rng.integers(8, 33)),
+            arrival_time=float(arrivals[i]),
+        ))
+    return reqs, shared
+
+
+def run_shared_prefix(args, engine, cfg):
+    """The feature matrix over one shared-prefix workload: (prefix_cache,
+    chunked_prefill) OFF/OFF vs ON/ON (plus the single-feature cells with
+    --full-matrix). Fresh ServingEngine per cell — same InferenceEngine
+    params, so every cell decodes the same model."""
+    from deepspeed_tpu.inference import Request, ServingEngine
+
+    requests, _ = build_shared_prefix_workload(
+        args.requests, args.rate, args.seed, cfg.vocab_size, args.prefix_len)
+    cells = [(False, False), (True, True)]
+    if args.full_matrix:
+        cells = [(False, False), (True, False), (False, True), (True, True)]
+
+    warm_rng = np.random.default_rng(args.seed + 1)
+    matrix = []
+    for use_prefix, use_chunked in cells:
+        serving = ServingEngine(
+            engine, n_slots=args.slots, max_seq_len=cfg.max_seq_len,
+            seed=args.seed,
+            config={
+                "jsonl_path": args.jsonl if (use_prefix and use_chunked) else "",
+                "prefix_cache": {
+                    "enabled": use_prefix, "n_slots": max(args.slots, 8),
+                    "max_prefix_len": args.prefix_len, "block": 32,
+                },
+                "chunked_prefill": {"enabled": use_chunked, "chunk_size": 128},
+            })
+        # warm the compiled-program set with an UNRELATED shared prefix (the
+        # measured prefix must not be pre-cached): request 1 compiles the
+        # miss path (full prefill + store), requests 2-4 repeat the warm
+        # prefix and compile the HIT path (prefix fetch + every bucketed
+        # tail width a 8-48 token tail can produce: 64/32/16). The timed
+        # TTFTs then measure scheduling, not first-use XLA compiles.
+        warm_prefix = warm_rng.integers(
+            0, cfg.vocab_size, size=args.prefix_len).astype(np.int32)
+        for i, tail_len in enumerate((63, 33, 17, 9)):
+            tail = warm_rng.integers(0, cfg.vocab_size, size=tail_len).astype(np.int32)
+            serving.serve([Request(uid=10**9 + i,
+                                   prompt=np.concatenate([warm_prefix, tail]),
+                                   max_new_tokens=4)])
+        pfx_before = serving.prefix_cache_stats() if use_prefix else None
+        t0 = time.perf_counter()
+        results = serving.serve(requests)
+        makespan = time.perf_counter() - t0
+        ttfts = [r.ttft for r in results.values()]
+        tpots = [r.time_per_output_token for r in results.values()
+                 if len(r.tokens) > 1]
+        total = sum(len(r.tokens) for r in results.values())
+        cell = {
+            "prefix_cache": use_prefix,
+            "chunked_prefill": use_chunked,
+            **_metrics(ttfts, tpots, total, makespan, serving.compile_counts()),
+        }
+        if use_prefix:
+            # delta over the timed serve — cumulative index stats would fold
+            # the warm-up requests' hits/inserts into the reported numbers
+            st = serving.prefix_cache_stats()
+            d = {k: st[k] - pfx_before[k] for k in (
+                "hits", "misses", "tokens_reused", "inserts", "evictions")}
+            lookups = d["hits"] + d["misses"]
+            cell["prefix_stats"] = {
+                **d,
+                "hit_rate": d["hits"] / lookups if lookups else 0.0,
+                "used_slots": st["used_slots"],
+            }
+        if use_prefix and use_chunked and args.jsonl:
+            serving.telemetry_snapshot()
+        matrix.append(cell)
+
+    off = next(c for c in matrix if not c["prefix_cache"] and not c["chunked_prefill"])
+    on = next(c for c in matrix if c["prefix_cache"] and c["chunked_prefill"])
+    return {
+        "bench": "serving_shared_prefix",
+        "requests": args.requests,
+        "slots": args.slots,
+        "poisson_rate_per_sec": args.rate,
+        "prefix_len": args.prefix_len,
+        "feature_matrix": matrix,
+        # the acceptance numbers: TTFT must DROP with the features on, and
+        # decode throughput must not regress
+        "ttft_p50_speedup": (off["ttft_sec"]["p50"] / on["ttft_sec"]["p50"]
+                             if on["ttft_sec"]["p50"] > 0 else float("inf")),
+        "ttft_p99_speedup": (off["ttft_sec"]["p99"] / on["ttft_sec"]["p99"]
+                             if on["ttft_sec"]["p99"] > 0 else float("inf")),
+        "tokens_per_sec_ratio": (on["tokens_per_sec"] / off["tokens_per_sec"]
+                                 if off["tokens_per_sec"] > 0 else float("inf")),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=10)
@@ -142,6 +267,12 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--jsonl", default="", help="telemetry JSONL event log path "
                     "(pretty-print with python -m deepspeed_tpu.telemetry.report)")
+    ap.add_argument("--workload", choices=("ragged", "shared_prefix"),
+                    default="ragged")
+    ap.add_argument("--prefix-len", type=int, default=512,
+                    help="shared system-prompt length (shared_prefix workload)")
+    ap.add_argument("--full-matrix", action="store_true",
+                    help="also run the single-feature matrix cells")
     args = ap.parse_args()
 
     import os
@@ -158,13 +289,20 @@ def main():
 
     # smoke-class model; the xla decode path keeps the CPU run honest (the
     # Pallas kernel would fall to interpret mode off-TPU and swamp the
-    # scheduling effects being measured)
+    # scheduling effects being measured). shared_prefix needs room for the
+    # system prompt + tail + generation in one slot.
+    seq = 256 if args.workload == "ragged" else _next_seq(args.prefix_len + 48 + 33)
     cfg = TransformerConfig(
-        vocab_size=1024, max_seq_len=256, num_layers=2, num_heads=4,
+        vocab_size=1024, max_seq_len=seq, num_layers=2, num_heads=4,
         hidden_size=64, dtype=jnp.float32, loss_chunk_size=0,
         decode_attn="xla", pos_emb="rotary",
     )
     engine = InferenceEngine(model=Model(cfg), config={"dtype": "fp32"})
+
+    if args.workload == "shared_prefix":
+        print(json.dumps(run_shared_prefix(args, engine, cfg)))
+        return
+
     requests = build_workload(args.requests, args.rate, args.seed, cfg.vocab_size)
 
     seq = run_sequential(engine, requests)

@@ -1,5 +1,5 @@
 """Continuous-batching serving engine: slot-based KV cache, ONE compiled
-decode step, bucketed prefill.
+decode step, bucketed prefill, prefix-cache KV reuse, chunked prefill.
 
 The reference's inference pillar (deepspeed/inference/engine.py) serves a
 single static batch per call; heavy multi-tenant traffic needs Orca-style
@@ -29,14 +29,46 @@ programs over sharded state:
                              token at the live prompt position
                              (``last_index`` — never materializing the
                              padded tail's logits).
-  * host scheduler         — admission queue ordered by arrival, slot
-                             eviction on EOS / max-tokens, request→response
-                             bookkeeping, and a wall-clock ``serve`` driver.
+  * prefix cache           — RadixAttention-style prompt KV reuse (SGLang,
+                             Zheng et al. 2023): a host-side trie
+                             (inference/prefix_cache.py) maps prompt token
+                             prefixes to slots of a sharded device pool
+                             [L, n_prefix_slots, Pmax, H, Dh] (same layout
+                             rule as the slot cache). On admit the longest
+                             cached prefix is copied into the request's slot
+                             by ONE compiled ``prefix_fetch`` program (slot
+                             indices are array operands) and only the suffix
+                             is prefilled; after prefill ONE ``prefix_store``
+                             program caches the new prompt's prefix per the
+                             insertion policy. Ref-counted LRU eviction.
+  * chunked prefill        — Sarathi-Serve-style admission (Agrawal et al.
+                             2024): prompt suffixes are split into fixed-size
+                             chunks plus ONE power-of-two-bucketed padded
+                             tail (one compiled program per width, so the
+                             program set is {C, C/2, ...} — a handful of
+                             STABLE programs, never one per prompt length).
+                             Each chunk slices the request's slot window out
+                             of the cache, extends it through
+                             ``apply_with_cache`` at the chunk's offset
+                             (per-row positions + causal offset: chunk i
+                             attends to KV written by chunks < i and the
+                             fetched prefix), and writes back only the
+                             chunk's region. ``step()`` interleaves chunks
+                             with decode steps, so active slots never stall
+                             behind a long prompt for more than one chunk.
+                             Admission is a state machine:
+                             queued -> prefilling(k chunks done) -> decoding.
+  * host scheduler         — admission picks the earliest ARRIVED request
+                             (a future-dated queue head never blocks later
+                             traffic), slot eviction on EOS / max-tokens,
+                             request→response bookkeeping, and a wall-clock
+                             ``serve`` driver.
 
-Inactive slots still flow through the decode program (static shapes are the
-whole point); their writes land at position 0 of a free slot and are
-overwritten by the next prefill, and their sampled tokens are discarded by
-the host. Repetition penalty is NOT supported here: its [n_slots, vocab]
+Inactive and mid-prefill slots still flow through the decode program
+(static shapes are the whole point); they WRITE at position Smax — the
+cache scatter's ``mode="drop"`` discards the garbage KV — while attending
+at position 0, and their sampled tokens are discarded by the host.
+Repetition penalty is NOT supported here: its [n_slots, vocab]
 "seen" carry would dominate the cache HBM for large vocabs — use
 ``InferenceEngine.generate`` for penalty-constrained decoding.
 """
@@ -55,10 +87,12 @@ import numpy as np
 from jax.sharding import NamedSharding
 
 from ..models import transformer as tfm
-from ..parallel.sharding import kv_slot_cache_spec
+from ..parallel.sharding import kv_prefix_pool_spec, kv_slot_cache_spec
+from ..runtime.config import ChunkedPrefillConfig, PrefixCacheConfig
 from ..telemetry import Telemetry
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
+from .prefix_cache import PrefixIndex
 from .sampling import sample_logits_vector
 
 
@@ -96,6 +130,7 @@ class RequestResult:
     first_token_time: float = 0.0  # TTFT reference point
     finish_time: float = 0.0
     slot: int = -1
+    prefix_hit_tokens: int = 0  # prompt tokens reused from the prefix cache
 
     @property
     def ttft(self) -> float:
@@ -116,12 +151,29 @@ class _Slot:
     eos: int = -1  # -1 = never matches
     result: Optional[RequestResult] = None
     tokens: list = field(default_factory=list)
+    prefix_entry: object = None  # acquired PrefixEntry released on finish
+
+
+@dataclass
+class _Prefill:
+    """A slot mid-admission: prefilling(idx of len(segments) chunks done).
+    The slot is occupied (not in ``_free``) but not yet decoding
+    (``_active`` false) — decode steps run alongside untouched."""
+
+    req: Request
+    slot: int
+    prompt: np.ndarray  # [S] int32
+    segments: list  # [(start, width, live_len)] covering [prefix_len, S)
+    idx: int = 0
+    entry: object = None  # PrefixEntry backing the fetched prefix (acquired)
+    t_admit: float = 0.0  # epoch-relative admission time
 
 
 class ServingEngine:
     """Continuous batching over an ``InferenceEngine``'s model/params.
 
-    Config keys (``config`` dict or keyword arguments; kwargs win):
+    Config keys (``config`` dict or keyword arguments; kwargs win —
+    the ``serving`` block of runtime/config.py is this dict's schema):
       n_slots             concurrent sequences resident in the slot cache
       max_seq_len         per-slot admission budget (prompt + generated);
                           must not exceed the engine's sequence budget. Only
@@ -131,13 +183,21 @@ class ServingEngine:
       min_prefill_bucket  smallest prompt bucket (power of two padding floor)
       seed                sampler PRNG seed
       jsonl_path          telemetry JSONL event log ("" = off)
-      watchdog_mode       off|warn|raise when the compile-stable decode path
+      watchdog_mode       off|warn|raise when a compile-stable path
                           compiles a second time (default warn)
+      prefix_cache        {enabled, n_slots, max_prefix_len, block,
+                          insert_policy, min_hits} — prompt-prefix KV reuse
+                          (runtime/config.PrefixCacheConfig; docs/serving.md)
+      chunked_prefill     {enabled, chunk_size, chunks_per_step} — admission
+                          chunks interleaved with decode
+                          (runtime/config.ChunkedPrefillConfig)
 
     Telemetry is always on (host-side dict updates per step — decode already
     pays a device call): TTFT/TPOT histograms, queue depth, slot occupancy,
-    admissions/evictions, per-bucket prefill counts, and a recompile
-    watchdog over decode (stable: ONE program) and each prefill bucket.
+    admissions/evictions, per-bucket prefill counts, prefix-cache hit/reuse
+    counters + pool-occupancy gauge, chunks-per-admit histogram, and a
+    recompile watchdog over decode (stable: ONE program), each prefill
+    bucket, each chunk width, and the prefix fetch/store programs.
     ``telemetry_snapshot()`` reports everything in one call; pass
     ``telemetry=`` to share a bundle across engines.
     """
@@ -145,11 +205,17 @@ class ServingEngine:
     def __init__(self, engine: InferenceEngine, config: dict | None = None,
                  *, n_slots: int | None = None, max_seq_len: int | None = None,
                  min_prefill_bucket: int | None = None, seed: int | None = None,
-                 telemetry: Telemetry | None = None):
+                 telemetry: Telemetry | None = None,
+                 prefix_cache: PrefixCacheConfig | dict | None = None,
+                 chunked_prefill: ChunkedPrefillConfig | dict | None = None):
         config = dict(config or {})
         n_slots = n_slots if n_slots is not None else config.get("n_slots", 8)
         max_seq_len = max_seq_len if max_seq_len is not None else config.get(
-            "max_seq_len", min(engine.cfg.max_seq_len, engine.max_out_tokens))
+            "max_seq_len", 0)
+        # 0/None = the engine's sequence budget — the typed schema's default
+        # (runtime/config.ServingConfig.max_seq_len=0), so a dataclass dump
+        # of the `serving` block drops in unchanged
+        max_seq_len = max_seq_len or min(engine.cfg.max_seq_len, engine.max_out_tokens)
         min_prefill_bucket = (min_prefill_bucket if min_prefill_bucket is not None
                               else config.get("min_prefill_bucket", 16))
         seed = seed if seed is not None else config.get("seed", 0)
@@ -157,6 +223,15 @@ class ServingEngine:
             jsonl_path=config.get("jsonl_path", ""),
             watchdog_mode=config.get("watchdog_mode", "warn"),
         )
+        pc = prefix_cache if prefix_cache is not None else config.get("prefix_cache", {})
+        if isinstance(pc, dict):
+            pc = PrefixCacheConfig(**pc)
+        cp = (chunked_prefill if chunked_prefill is not None
+              else config.get("chunked_prefill", {}))
+        if isinstance(cp, dict):
+            cp = ChunkedPrefillConfig(**cp)
+        self.prefix_cfg: PrefixCacheConfig = pc
+        self.chunk_cfg: ChunkedPrefillConfig = cp
 
         self.engine = engine
         self.cfg = engine.cfg
@@ -192,6 +267,29 @@ class ServingEngine:
             out_shardings=self._cache_sharding,
         )()
 
+        # prefix pool: the slot cache's sibling — same [L, slots, len, H, Dh]
+        # layout, holding cached prompt prefixes instead of live sequences
+        self._pfx: Optional[PrefixIndex] = None
+        self._pool = None
+        if pc.enabled:
+            self._pmax = int(pc.max_prefix_len) or self.Smax
+            if self._pmax > self.Smax:
+                raise ValueError(
+                    f"prefix_cache.max_prefix_len ({self._pmax}) exceeds the "
+                    f"slot cache length {self.Smax}")
+            pool_spec = kv_prefix_pool_spec(self.mesh, pc.n_slots, self.cfg.num_heads)
+            self._pool_sharding = NamedSharding(self.mesh, pool_spec)
+            self._pool_shardings = {"k": self._pool_sharding, "v": self._pool_sharding}
+            self._pool = jax.jit(
+                partial(tfm.init_cache, self.cfg, pc.n_slots, self._pmax,
+                        dtype=self.cfg.dtype),
+                out_shardings=self._pool_sharding,
+            )()
+            self._pfx = PrefixIndex(pc.n_slots, pc.block,
+                                    insert_policy=pc.insert_policy,
+                                    min_hits=pc.min_hits)
+            self.telemetry.gauge("serving/prefix_pool_slots").set(pc.n_slots)
+
         # host-side slot state (device twins are passed per step as arrays)
         n = self.n_slots
         self._slots = [_Slot() for _ in range(n)]
@@ -204,15 +302,26 @@ class ServingEngine:
         self._top_p = np.ones((n,), np.float32)
 
         self._queue: deque[Request] = deque()
+        self._prefilling: dict[int, _Prefill] = {}  # slot -> admission state
+        self._rr = 0  # round-robin cursor over prefilling slots
         self._results: dict[int, RequestResult] = {}
         self._epoch = time.perf_counter()
         self._decode = None  # jitted lazily (params pytree shapes needed)
         self._prefills: dict[int, object] = {}  # bucket len -> jitted prefill
+        self._chunk_progs: dict[int, object] = {}  # chunk width -> jitted chunk
+        self._fetch = None  # jitted prefix pool -> slot copy
+        self._store = None  # jitted slot -> prefix pool copy
         self._decode_steps = 0
+        feat = []
+        if pc.enabled:
+            feat.append(f"prefix_cache[{pc.n_slots}x{self._pmax}, "
+                        f"block {pc.block}, {pc.insert_policy}]")
+        if cp.enabled:
+            feat.append(f"chunked_prefill[{cp.chunk_size}]")
         log_dist(
             f"serving engine: {n} slots x {self.Smax} tokens, cache "
             f"{2 * self.cfg.num_layers * n * self.Smax * self.cfg.hidden_size * jnp.dtype(self.cfg.dtype).itemsize / 1e6:.1f} MB, "
-            f"spec={spec}", ranks=[0],
+            f"spec={spec}" + (", " + ", ".join(feat) if feat else ""), ranks=[0],
         )
 
     # -- compiled programs ----------------------------------------------
@@ -220,10 +329,15 @@ class ServingEngine:
     def _build_decode(self):
         cfg = self.cfg
 
-        def decode(params, cache, toks, pos, active, rng, temp, top_k, top_p):
-            # toks/pos/active/temp/top_k/top_p are all [n_slots] ARRAYS —
-            # nothing about an individual request is baked into the program
-            logits, cache = tfm.apply_with_cache(cfg, params, toks[:, None], cache, pos)
+        def decode(params, cache, toks, pos, wpos, active, rng, temp, top_k, top_p):
+            # toks/pos/wpos/active/temp/top_k/top_p are all [n_slots] ARRAYS
+            # — nothing about an individual request is baked into the
+            # program. wpos decouples the KV write from the attention
+            # position: inactive/prefilling rows write at Smax (dropped by
+            # the scatter) but ATTEND at pos 0, so the length-aware decode
+            # kernel streams one block for an idle row, not the whole cache
+            logits, cache = tfm.apply_with_cache(
+                cfg, params, toks[:, None], cache, pos, write_pos=wpos)
             nxt = sample_logits_vector(logits[:, 0], rng, temp, top_k, top_p)
             return cache, jnp.where(active, nxt, 0)
 
@@ -251,8 +365,103 @@ class ServingEngine:
         return jax.jit(prefill, donate_argnums=(1,),
                        out_shardings=(self._cache_shardings, None))
 
+    def _build_chunk(self, width: int):
+        cfg = self.cfg
+        Smax = self.Smax
+
+        def chunk(params, cache, toks, slot, start, true_len, rng, temp, top_k, top_p):
+            # toks [1, width] prompt tokens entering at absolute position
+            # ``start`` of row ``slot`` (slot/start/true_len are all traced
+            # scalars — one program per width, never per slot/offset/length).
+            # The slot's window is sliced out, extended through the
+            # cache-attention path (the per-row position vector makes this
+            # chunk attend to the prefix and every earlier chunk already
+            # resident in the window), and splatted back. Only the slot's
+            # own row is ever written: other slots' mid-decode KV cannot be
+            # perturbed. A final tail chunk may be padded past ``true_len``
+            # (bucketed like the one-shot prefill); the pad's garbage KV at
+            # positions >= the prompt length is overwritten by decode steps
+            # before any query position can attend to it, and ``last_index``
+            # projects only the live last token's logits.
+            local = tfm.slice_cache_slot(cache, slot, Smax)
+            logits, local = tfm.apply_with_cache(
+                cfg, params, toks, local, jnp.reshape(start, (1,)),
+                last_index=true_len - 1)
+            tok = sample_logits_vector(logits[:, 0], rng, temp, top_k, top_p)
+            # write back ONLY the chunk's region [start, start+width) — the
+            # rest of the window is unchanged, and splatting all Smax
+            # positions per chunk would multiply the cache-write bandwidth
+            # by Smax/width on exactly the prompt-side hot path
+            new_kv = tfm.slice_cache_slot(local, 0, width, start=start)
+            return tfm.update_cache_slot(cache, new_kv, slot, start=start), tok
+
+        return jax.jit(chunk, donate_argnums=(1,),
+                       out_shardings=(self._cache_shardings, None))
+
+    def _build_fetch(self):
+        pmax = self._pmax
+
+        def fetch(cache, pool, pool_slot, slot):
+            # the whole [0, Pmax) window is copied (static width — ONE
+            # program); positions past the entry's live length are garbage
+            # the suffix prefill / decode writes overwrite before any query
+            # position can attend to them
+            return tfm.update_cache_slot(
+                cache, tfm.slice_cache_slot(pool, pool_slot, pmax), slot)
+
+        return jax.jit(fetch, donate_argnums=(0,),
+                       out_shardings=self._cache_shardings)
+
+    def _build_store(self):
+        pmax = self._pmax
+
+        def store(pool, cache, slot, pool_slot):
+            return tfm.update_cache_slot(
+                pool, tfm.slice_cache_slot(cache, slot, pmax), pool_slot)
+
+        return jax.jit(store, donate_argnums=(0,),
+                       out_shardings=self._pool_shardings)
+
     def _bucket_len(self, S: int) -> int:
         return min(_next_pow2(max(S, self.min_bucket)), self.Smax)
+
+    def _chunk_prog(self, width: int):
+        if width not in self._chunk_progs:
+            wd = self.telemetry.watchdog
+            self._chunk_progs[width] = wd.watch(
+                self._build_chunk(width),
+                wd.unique_name(f"serving/chunk_prefill[{width}]"), stable=True)
+        return self._chunk_progs[width]
+
+    def _segments(self, start: int, S: int) -> list[tuple[int, int, int]]:
+        """Split [start, S) into (start, width, live_len) chunk segments:
+        full ``chunk_size`` chunks, then ONE power-of-two bucketed segment
+        for the remainder (padded, exactly like the one-shot prefill — a
+        short post-hit suffix reaches its first token in a single step
+        instead of dripping through log2(r) sub-chunks). Only when the
+        padded bucket would spill past the cache end does the remainder fall
+        back to its unpadded binary decomposition. Widths are powers of two
+        <= chunk_size, so the compiled-program set stays bounded by
+        log2(chunk_size) — never one program per prompt length."""
+        C = self.chunk_cfg.chunk_size
+        segs = []
+        p = start
+        while S - p >= C:
+            segs.append((p, C, C))
+            p += C
+        r = S - p
+        if r > 0:
+            b = min(_next_pow2(max(r, min(self.min_bucket, C))), C)
+            if p + b <= self.Smax:
+                segs.append((p, b, r))
+            else:
+                while r > 0:
+                    while b > r:
+                        b //= 2
+                    segs.append((p, b, b))
+                    p += b
+                    r -= b
+        return segs
 
     # -- scheduler ------------------------------------------------------
 
@@ -271,7 +480,8 @@ class ServingEngine:
         # a duplicate uid would overwrite its twin's result and leave
         # serve()'s completion count short — spinning forever
         live = ({r.uid for r in self._queue} | set(self._results)
-                | {s.uid for s in self._slots if s.uid >= 0})
+                | {s.uid for s in self._slots if s.uid >= 0}
+                | {p.req.uid for p in self._prefilling.values()})
         if request.uid in live:
             raise ValueError(f"request uid {request.uid} is already in flight "
                              "or finished; uids must be unique per engine")
@@ -282,62 +492,205 @@ class ServingEngine:
     def n_active(self) -> int:
         return int(self._active.sum())
 
+    @property
+    def n_prefilling(self) -> int:
+        return len(self._prefilling)
+
+    def _pop_earliest_arrived(self, now: float) -> Optional[Request]:
+        """Earliest-arrival request whose arrival_time has passed, removed
+        from the queue — NOT the queue head: a future-dated head must never
+        block admission of later-submitted requests that have already
+        arrived (head-of-line fix)."""
+        best_i = -1
+        best_t = None
+        for i, r in enumerate(self._queue):
+            if r.arrival_time <= now and (best_t is None or r.arrival_time < best_t):
+                best_i, best_t = i, r.arrival_time
+        if best_i < 0:
+            return None
+        req = self._queue[best_i]
+        del self._queue[best_i]
+        return req
+
     def _admit(self, now: float):
-        """Move arrived requests from the queue into free slots (prefill)."""
-        while self._free and self._queue and self._queue[0].arrival_time <= now:
-            req = self._queue.popleft()
+        """Move arrived requests from the queue into free slots. Without
+        prefix/chunk features this runs the legacy one-shot bucketed prefill;
+        otherwise it fetches the cached prefix and leaves the request in the
+        ``prefilling`` state for step() to advance chunk by chunk."""
+        tm = self.telemetry
+        while self._free and self._queue:
+            req = self._pop_earliest_arrived(now)
+            if req is None:
+                break
             slot = self._free.popleft()
             prompt = np.asarray(req.prompt, np.int32).reshape(-1)
             S = prompt.shape[0]
-            bucket = self._bucket_len(S)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :S] = prompt
-            if bucket not in self._prefills:
-                # each bucket length is its own compile-stable program: one
-                # compile at first use, never again
-                wd = self.telemetry.watchdog
-                self._prefills[bucket] = wd.watch(
-                    self._build_prefill(bucket),
-                    wd.unique_name(f"serving/prefill[{bucket}]"), stable=True)
-            self._rng, k = jax.random.split(self._rng)
-            t_pre = time.perf_counter()
-            self._cache, tok = self._prefills[bucket](
-                self.params, self._cache, jnp.asarray(padded),
-                jnp.int32(slot), jnp.int32(S), k,
-                jnp.asarray([req.temperature], jnp.float32),
-                jnp.asarray([req.top_k], jnp.int32),
-                jnp.asarray([req.top_p], jnp.float32),
-            )
-            first = int(np.asarray(jax.device_get(tok))[0])
-            t_first = time.perf_counter() - self._epoch
-            tm = self.telemetry
-            # the token fetch above synced, so this wall time is device-true;
-            # the compiling call is excluded — compile/wall_s records it, and
-            # folding it in would make the latency tail pure compile time
-            if not self._prefills[bucket].last_call_compiled:
-                tm.histogram("serving/prefill_sec").observe(time.perf_counter() - t_pre)
+            t_adm = time.perf_counter() - self._epoch
             tm.counter("serving/admissions").inc()
-            tm.counter(f"serving/prefill_bucket[{bucket}]").inc()
             tm.histogram("serving/queue_wait_sec").observe(
-                max((t_pre - self._epoch) - req.arrival_time, 0.0))
-            st = self._slots[slot]
-            st.uid = req.uid
-            st.remaining = req.max_new_tokens - 1
-            st.eos = req.eos_token if req.eos_token is not None else -1
-            st.tokens = [first]
-            st.result = RequestResult(
-                uid=req.uid, tokens=np.zeros((0,), np.int32), prompt_len=S,
-                arrival_time=req.arrival_time, admitted_time=t_first,
-                first_token_time=t_first, slot=slot,
-            )
-            self._active[slot] = True
-            self._pos[slot] = S
-            self._last_tok[slot] = first
-            self._temp[slot] = req.temperature
-            self._top_k[slot] = req.top_k
-            self._top_p[slot] = req.top_p
-            if first == st.eos or st.remaining <= 0:
-                self._finish(slot)
+                max(t_adm - req.arrival_time, 0.0))
+
+            entry = None
+            if self._pfx is not None:
+                # at most S-1 tokens are reusable: the first sampled token
+                # needs the LAST prompt position's logits, so at least one
+                # suffix token must run through a prefill program
+                entry = self._pfx.lookup(prompt, min(S - 1, self._pmax))
+                if entry is not None:
+                    self._pfx.acquire(entry)
+                    tm.counter("serving/prefix_hits").inc()
+                    tm.counter("serving/prefix_tokens_reused").inc(entry.length)
+                    if self._fetch is None:
+                        wd = tm.watchdog
+                        self._fetch = wd.watch(
+                            self._build_fetch(),
+                            wd.unique_name("serving/prefix_fetch"), stable=True)
+                    self._cache = self._fetch(
+                        self._cache, self._pool,
+                        jnp.int32(entry.pool_slot), jnp.int32(slot))
+                else:
+                    tm.counter("serving/prefix_misses").inc()
+            P = entry.length if entry is not None else 0
+
+            if P == 0 and not self.chunk_cfg.enabled:
+                # legacy blocking path: whole prompt through one bucketed
+                # prefill program (compile-compatible with pre-feature
+                # engines — same program, same XLA cache entries)
+                tm.histogram("serving/chunks_per_admit").observe(1)
+                self._prefill_one_shot(req, slot, prompt, t_adm, entry)
+                continue
+
+            segments = self._segments(P, S)
+            tm.histogram("serving/chunks_per_admit").observe(len(segments))
+            self._prefilling[slot] = _Prefill(
+                req=req, slot=slot, prompt=prompt, segments=segments,
+                entry=entry, t_admit=t_adm)
+            if not self.chunk_cfg.enabled:
+                # prefix hit with chunking off: the suffix still runs through
+                # the window path (it must attend to the fetched prefix), but
+                # all segments run back-to-back — legacy blocking semantics
+                while slot in self._prefilling:
+                    self._advance_prefill(slot)
+
+    def _prefill_one_shot(self, req: Request, slot: int, prompt: np.ndarray,
+                          t_adm: float, entry):
+        tm = self.telemetry
+        S = prompt.shape[0]
+        bucket = self._bucket_len(S)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :S] = prompt
+        if bucket not in self._prefills:
+            # each bucket length is its own compile-stable program: one
+            # compile at first use, never again
+            wd = tm.watchdog
+            self._prefills[bucket] = wd.watch(
+                self._build_prefill(bucket),
+                wd.unique_name(f"serving/prefill[{bucket}]"), stable=True)
+        self._rng, k = jax.random.split(self._rng)
+        t_pre = time.perf_counter()
+        self._cache, tok = self._prefills[bucket](
+            self.params, self._cache, jnp.asarray(padded),
+            jnp.int32(slot), jnp.int32(S), k,
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32),
+        )
+        first = int(np.asarray(jax.device_get(tok))[0])
+        t_first = time.perf_counter() - self._epoch
+        # the token fetch above synced, so this wall time is device-true;
+        # the compiling call is excluded — compile/wall_s records it, and
+        # folding it in would make the latency tail pure compile time
+        if not self._prefills[bucket].last_call_compiled:
+            tm.histogram("serving/prefill_sec").observe(time.perf_counter() - t_pre)
+        tm.counter(f"serving/prefill_bucket[{bucket}]").inc()
+        self._activate(slot, req, prompt, first, t_adm, t_first, entry)
+
+    def _advance_prefill(self, slot: int):
+        """Run ONE chunk of the slot's admission prefill; on the final chunk
+        the first token is sampled and the slot flips to decoding."""
+        pf = self._prefilling[slot]
+        start, width, live = pf.segments[pf.idx]
+        toks = np.zeros((1, width), np.int32)
+        toks[0, :live] = pf.prompt[start:start + live]
+        prog = self._chunk_prog(width)
+        tm = self.telemetry
+        self._rng, k = jax.random.split(self._rng)
+        t0 = time.perf_counter()
+        self._cache, tok = prog(
+            self.params, self._cache, jnp.asarray(toks),
+            jnp.int32(slot), jnp.int32(start), jnp.int32(live), k,
+            jnp.asarray([pf.req.temperature], jnp.float32),
+            jnp.asarray([pf.req.top_k], jnp.int32),
+            jnp.asarray([pf.req.top_p], jnp.float32),
+        )
+        tm.counter(f"serving/chunk_bucket[{width}]").inc()
+        pf.idx += 1
+        if pf.idx < len(pf.segments):
+            # intermediate chunk: the sampled token is garbage (mid-prompt
+            # logits) and deliberately NOT fetched — the chunk stays an
+            # async dispatch the next decode step overlaps with
+            return
+        first = int(np.asarray(jax.device_get(tok))[0])
+        t_first = time.perf_counter() - self._epoch
+        # device-true (the fetch synced); the compiling call is excluded
+        if not prog.last_call_compiled:
+            tm.histogram("serving/chunk_prefill_sec").observe(time.perf_counter() - t0)
+        del self._prefilling[slot]
+        self._activate(slot, pf.req, pf.prompt, first, pf.t_admit, t_first, pf.entry)
+
+    def _activate(self, slot: int, req: Request, prompt: np.ndarray,
+                  first: int, t_adm: float, t_first: float, entry):
+        """Prompt KV fully resident in the slot + first token sampled:
+        flip the slot to decoding and (policy permitting) cache the prompt's
+        prefix for future admissions."""
+        S = prompt.shape[0]
+        st = self._slots[slot]
+        st.uid = req.uid
+        st.remaining = req.max_new_tokens - 1
+        st.eos = req.eos_token if req.eos_token is not None else -1
+        st.tokens = [first]
+        st.prefix_entry = entry
+        st.result = RequestResult(
+            uid=req.uid, tokens=np.zeros((0,), np.int32), prompt_len=S,
+            arrival_time=req.arrival_time, admitted_time=t_adm,
+            first_token_time=t_first, slot=slot,
+            prefix_hit_tokens=entry.length if entry is not None else 0,
+        )
+        self._active[slot] = True
+        self._pos[slot] = S
+        self._last_tok[slot] = first
+        self._temp[slot] = req.temperature
+        self._top_k[slot] = req.top_k
+        self._top_p[slot] = req.top_p
+        if self._pfx is not None:
+            self._insert_prefix(slot, prompt)
+        if first == st.eos or st.remaining <= 0:
+            self._finish(slot)
+
+    def _insert_prefix(self, slot: int, prompt: np.ndarray):
+        """Offer the freshly prefilled prompt to the prefix cache; a created
+        entry copies the slot's leading window into the pool with the ONE
+        compiled store program."""
+        tm = self.telemetry
+        skips_before = self._pfx.insert_skips
+        res = self._pfx.insert(prompt, min(prompt.shape[0] - 1, self._pmax))
+        if res.evicted is not None:
+            tm.counter("serving/prefix_evictions").inc()
+        if res.created:
+            if self._store is None:
+                wd = tm.watchdog
+                self._store = wd.watch(
+                    self._build_store(),
+                    wd.unique_name("serving/prefix_store"), stable=True)
+            self._pool = self._store(
+                self._pool, self._cache, jnp.int32(slot),
+                jnp.int32(res.entry.pool_slot))
+            tm.counter("serving/prefix_inserts").inc()
+        elif self._pfx.insert_skips > skips_before:
+            # the index declined (pool full of in-use prefixes / below the
+            # min_hits popularity bar) — distinct from "already cached"
+            tm.counter("serving/prefix_insert_skips").inc()
+        tm.gauge("serving/prefix_pool_used").set(self._pfx.used_slots)
 
     def _finish(self, slot: int):
         st = self._slots[slot]
@@ -345,6 +698,8 @@ class ServingEngine:
         st.result.finish_time = time.perf_counter() - self._epoch
         self._results[st.uid] = st.result
         res = st.result
+        if st.prefix_entry is not None:
+            self._pfx.release(st.prefix_entry)
         tm = self.telemetry
         tm.counter("serving/evictions").inc()
         tm.counter("serving/tokens_out").inc(len(res.tokens))
@@ -357,18 +712,24 @@ class ServingEngine:
             "prompt_len": res.prompt_len, "n_tokens": int(len(res.tokens)),
             "ttft_s": res.ttft, "tpot_s": tpot,
             "arrival_s": res.arrival_time, "finish_s": res.finish_time,
+            "prefix_hit_tokens": res.prefix_hit_tokens,
         })
         self._slots[slot] = _Slot()
         self._active[slot] = False
-        self._pos[slot] = 0  # park: decode writes for a free slot land at 0,
-        self._last_tok[slot] = 0  # overwritten by the next prefill
+        # pos 0 is the freed slot's ATTENTION position only (cheapest for the
+        # length-aware decode kernel); its decode WRITE goes to wpos=Smax and
+        # is dropped by the scatter — never park the write in range (step())
+        self._pos[slot] = 0
+        self._last_tok[slot] = 0
         self._temp[slot] = 0.0
         self._top_k[slot] = 0
         self._top_p[slot] = 1.0
         self._free.append(slot)
 
     def step(self, now: float | None = None) -> list[int]:
-        """One scheduler iteration: admit arrived requests, then advance
+        """One scheduler iteration: admit arrived requests, advance at most
+        ``chunks_per_step`` admission chunks (round-robin over prefilling
+        slots — active slots never stall behind a long prompt), then advance
         every active slot by one token (one device call). Returns the uids
         finished during this step."""
         if now is None:
@@ -376,6 +737,13 @@ class ServingEngine:
         self._admit(now)
         tm = self.telemetry
         tm.gauge("serving/queue_depth").set(len(self._queue))
+        tm.gauge("serving/prefilling_slots").set(len(self._prefilling))
+        for _ in range(self.chunk_cfg.chunks_per_step):
+            if not self._prefilling:
+                break
+            slots = sorted(self._prefilling)
+            self._advance_prefill(slots[self._rr % len(slots)])
+            self._rr += 1
         if not self._active.any():
             return []
         if self._decode is None:
@@ -391,10 +759,19 @@ class ServingEngine:
         tm.histogram("serving/queue_depth_hist").observe(len(self._queue))
         tm.histogram("serving/slot_occupancy").observe(n_active / self.n_slots)
         self._rng, k = jax.random.split(self._rng)
+        # inactive slots WRITE at position Smax — the cache scatter's
+        # mode="drop" discards their garbage KV entirely. Writing at 0 (the
+        # pre-chunked-prefill scheme) corrupted PREFILLING slots — a slot
+        # mid-admission already holds its prefix KV at position 0, and
+        # decode steps run interleaved with its remaining chunks. Their
+        # ATTENTION position stays self._pos (0 when idle), so the
+        # length-aware decode kernel never streams the full cache for them.
+        wpos = np.where(self._active, self._pos, np.int32(self.Smax))
         t_dec = time.perf_counter()
         self._cache, nxt = self._decode(
             self.params, self._cache, jnp.asarray(self._last_tok),
-            jnp.asarray(self._pos), jnp.asarray(self._active), k,
+            jnp.asarray(self._pos), jnp.asarray(wpos, np.int32),
+            jnp.asarray(self._active), k,
             jnp.asarray(self._temp), jnp.asarray(self._top_k),
             jnp.asarray(self._top_p),
         )
@@ -425,7 +802,7 @@ class ServingEngine:
     def drain(self) -> dict[int, RequestResult]:
         """Run steps until queue and slots are empty (ignoring arrival
         times); return all results so far."""
-        while self._queue or self._active.any():
+        while self._queue or self._prefilling or self._active.any():
             self.step(now=float("inf"))
         return dict(self._results)
 
@@ -437,15 +814,16 @@ class ServingEngine:
         this call's requests, timed against the engine epoch — which is
         reset only when the engine is idle, so in-flight requests' timings
         stay coherent."""
-        if not self._queue and not self._active.any():
+        if not self._queue and not self._prefilling and not self._active.any():
             self._epoch = time.perf_counter()
         target = set()
         for r in sorted(requests, key=lambda r: r.arrival_time):
             target.add(self.submit(r))
         while not target <= set(self._results):
             now = time.perf_counter() - self._epoch
-            if not self._active.any() and self._queue:
-                wait = self._queue[0].arrival_time - now
+            if (not self._active.any() and not self._prefilling
+                    and self._queue):
+                wait = min(r.arrival_time for r in self._queue) - now
                 if wait > 0:
                     time.sleep(min(wait, 0.05))
             self.step()
@@ -455,24 +833,44 @@ class ServingEngine:
 
     def compile_counts(self) -> dict:
         """How many XLA programs this engine traced — the continuous-batching
-        invariant is decode == 1 regardless of workload mix."""
-        return {
+        invariant is decode == 1 regardless of workload mix, and every chunk
+        width / prefix copy is likewise ONE program."""
+        out = {
             "decode": int(self._decode._cache_size()) if self._decode is not None else 0,
             "prefill": {b: int(f._cache_size()) for b, f in sorted(self._prefills.items())},
             "decode_steps": self._decode_steps,
         }
+        if self._chunk_progs:
+            out["chunk_prefill"] = {w: int(f._cache_size())
+                                    for w, f in sorted(self._chunk_progs.items())}
+        if self._fetch is not None:
+            out["prefix_fetch"] = int(self._fetch._cache_size())
+        if self._store is not None:
+            out["prefix_store"] = int(self._store._cache_size())
+        return out
+
+    def prefix_cache_stats(self) -> Optional[dict]:
+        """Host-side prefix-cache view: hit/miss/reuse totals, pool
+        occupancy, and the resident entries (length/hits/refs) — None when
+        the feature is off."""
+        return self._pfx.stats() if self._pfx is not None else None
 
     def telemetry_snapshot(self) -> dict:
         """ONE call that reports everything: the metrics registry (TTFT/TPOT/
         queue/occupancy histograms, admission/eviction/token counters), the
-        recompile table, the XLA program counts, and the trace-time
-        collective summary. Also appended to the JSONL log (type
-        ``snapshot``) when a sink is configured."""
+        recompile table, the XLA program counts, the trace-time collective
+        summary, and the prefix-cache table when the feature is on. Also
+        appended to the JSONL log (type ``snapshot``) when a sink is
+        configured."""
         from ..comm.logger import comms_logger
 
+        extra = {}
+        if self._pfx is not None:
+            extra["prefix_cache"] = self._pfx.stats()
         snap = self.telemetry.snapshot(
             compiles=self.compile_counts(),
             comm=comms_logger.summary(),
+            **extra,
         )
         self.telemetry.emit({"type": "snapshot", **snap})
         return snap

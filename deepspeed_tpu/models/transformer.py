@@ -953,6 +953,44 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int, dtype=None):
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def slice_cache_slot(cache, slot, length: int, start=0):
+    """Read one sequence's KV window out of a slot cache:
+    {k,v} [L, B, Smax, H, Dh] -> [L, 1, length, H, Dh] at row ``slot``,
+    positions [start, start+length). ``slot`` and ``start`` may be traced
+    int32 scalars — the caller's program stays compile-stable across
+    slots/offsets; ``length`` is static: it picks the compiled program.
+
+    The serving engine's chunked prefill and prefix-cache copies both run on
+    these windows: chunk programs slice a slot out, extend it through
+    ``apply_with_cache`` at the chunk's offset, and write back only the
+    chunk's region; prefix fetch/store move windows between the slot cache
+    and the prefix pool."""
+    L, _, Smax, H, Dh = cache["k"].shape
+    if length > Smax:
+        raise ValueError(f"cache window ({length}) exceeds cache length {Smax}")
+    slot = jnp.asarray(slot, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+    return {
+        kv: lax.dynamic_slice(cache[kv], (0, slot, start, 0, 0), (L, 1, length, H, Dh))
+        for kv in ("k", "v")
+    }
+
+
+def update_cache_slot(cache, window, slot, start=0):
+    """Write a [L, 1, W, H, Dh] KV window into row ``slot`` of a slot cache
+    at positions [start, start+W) (one ``dynamic_update_slice`` per k/v —
+    the inverse of ``slice_cache_slot``). ``slot``/``start`` are traced
+    scalars: one compiled program regardless of which slot/offset is
+    written."""
+    slot = jnp.asarray(slot, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+    return {
+        kv: lax.dynamic_update_slice(
+            cache[kv], window[kv].astype(cache[kv].dtype), (0, slot, start, 0, 0))
+        for kv in ("k", "v")
+    }
+
+
 def cached_attention(q, k_cache, v_cache, pos, *, bias=None):
     """Attention of q [B,T,H,Dh] against a [B,Smax,H,Dh] cache whose valid
     keys are [0, pos+T): the causal mask with offset ``pos`` covers the
@@ -964,7 +1002,7 @@ def cached_attention(q, k_cache, v_cache, pos, *, bias=None):
 
 def apply_with_cache(
     cfg: TransformerConfig, params: Params, tokens, cache, pos,
-    last_only: bool = False, last_index=None,
+    last_only: bool = False, last_index=None, write_pos=None,
 ):
     """tokens [B, T] entering at absolute position ``pos`` -> (logits, updated
     cache). Serves prefill (T=prompt) and decode (T=1). With ``last_only``
@@ -978,6 +1016,14 @@ def apply_with_cache(
     path) or a per-row [B] int32 vector (continuous batching: every cache
     slot decodes at its own absolute position; cache writes become per-row
     scatters and the causal mask is per-row).
+
+    ``write_pos`` (vector-``pos`` path only) decouples where a row's KV is
+    WRITTEN from where it attends/embeds: the serving engine passes
+    ``write_pos = Smax`` for inactive/prefilling slots so their garbage
+    write is dropped by the scatter while their attention position stays 0
+    — the length-aware decode kernel then streams one block for an idle
+    row instead of the whole cache. None = write at ``pos`` (every other
+    caller).
 
     MoE models decode through the same grouped scan as training (every
     ``moe_every``-th layer routes its FFN through the experts)."""
@@ -1033,15 +1079,23 @@ def apply_with_cache(
 
     if vector_pos:
         _rows = jnp.arange(B)[:, None]
+        if write_pos is None:
+            write_positions = positions
+        else:
+            write_positions = (jnp.asarray(write_pos, jnp.int32)[:, None]
+                               + jnp.arange(T)[None, :])
 
         def _write_cache(c, new):
-            # per-row scatter: row b's block lands at [pos[b], pos[b]+T).
-            # Freed serving slots are parked at pos 0 — their garbage write
-            # is overwritten by the next occupant's prefill (which rewrites
-            # [0, bucket)); mode="drop" is defense-in-depth discarding any
-            # out-of-range position a caller might pass
-            return c.at[_rows, positions].set(new.astype(c.dtype), mode="drop")
+            # per-row scatter: row b's block lands at [write_pos[b], +T).
+            # mode="drop" is load-bearing: the serving engine passes
+            # write_pos=Smax for inactive/prefilling slots so their garbage
+            # write is DISCARDED here — a mid-admission slot already holds
+            # prefix KV at the low positions, so no in-range parking spot
+            # is safe
+            return c.at[_rows, write_positions].set(new.astype(c.dtype), mode="drop")
     else:
+        if write_pos is not None:
+            raise ValueError("write_pos requires a per-row pos vector")
 
         def _write_cache(c, new):
             return lax.dynamic_update_slice(c, new.astype(c.dtype), (0, pos, 0, 0))

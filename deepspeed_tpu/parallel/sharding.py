@@ -172,6 +172,16 @@ def kv_slot_cache_spec(mesh: Mesh, n_slots: int, num_heads: int) -> PartitionSpe
     return PartitionSpec(None, slot, None, head_ax, None)
 
 
+def kv_prefix_pool_spec(mesh: Mesh, n_prefix_slots: int, num_heads: int) -> PartitionSpec:
+    """PartitionSpec for the serving engine's prefix-cache KV pool
+    [L, n_prefix_slots, Pmax, H, Dh] — deliberately the SAME layout rule as
+    ``kv_slot_cache_spec`` (pool slots over the ZeRO/data axes, heads over
+    the TP axis): the prefix fetch/store programs are dynamic-slice copies
+    between the pool and the slot cache, and matching layouts keep those
+    copies shard-local on the head axis instead of resharding every reuse."""
+    return kv_slot_cache_spec(mesh, n_prefix_slots, num_heads)
+
+
 def constrain(tree, mesh: Mesh, specs_tree):
     """with_sharding_constraint over a pytree (inside jit)."""
     flat_x, treedef = jax.tree.flatten(tree)

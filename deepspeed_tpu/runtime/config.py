@@ -228,6 +228,110 @@ class TelemetryConfig:
 
 
 @dataclass
+class PrefixCacheConfig:
+    """Serving prefix-cache block (``serving.prefix_cache``; docs/serving.md).
+
+    RadixAttention-style prompt KV reuse: a host-side trie maps prompt token
+    prefixes to slots of a device-side KV pool
+    ``[L, n_slots, max_prefix_len, H, Dh]``; admission copies the longest
+    cached prefix into the request's slot with one compiled program and
+    prefills only the suffix.
+
+    - ``enabled``: allocate the pool and consult the trie on every admission.
+    - ``n_slots``: pool capacity (cached prefixes resident on device).
+    - ``max_prefix_len``: pool window length (tokens per cached prefix);
+      0 = the serving slot length. Longer windows reuse more but cost
+      ``2 * L * n_slots * max_prefix_len * hidden`` bytes of HBM.
+    - ``block``: trie granularity — prefixes are cached/matched in whole
+      blocks of this many tokens.
+    - ``insert_policy``: ``always`` caches every admitted prompt's prefix;
+      ``min_hits`` caches a prefix only once ``min_hits`` prompts have
+      shared it (one-off prompts never consume a pool slot).
+    """
+
+    enabled: bool = False
+    n_slots: int = 8
+    max_prefix_len: int = 0  # 0 = the serving slot length (Smax)
+    block: int = 16
+    insert_policy: str = "always"
+    min_hits: int = 2
+
+    def __post_init__(self):
+        if self.insert_policy not in ("always", "min_hits"):
+            raise DeepSpeedConfigError(
+                f"serving.prefix_cache.insert_policy must be always|min_hits, "
+                f"got {self.insert_policy!r}")
+        if self.n_slots < 1:
+            raise DeepSpeedConfigError(
+                f"serving.prefix_cache.n_slots must be >= 1, got {self.n_slots}")
+        if self.block < 1:
+            raise DeepSpeedConfigError(
+                f"serving.prefix_cache.block must be >= 1, got {self.block}")
+        if self.min_hits < 1:
+            # min_hits <= 0 would make the popularity bar vacuous — every
+            # one-off prompt would cache on first traversal, silently
+            # turning min_hits into always
+            raise DeepSpeedConfigError(
+                f"serving.prefix_cache.min_hits must be >= 1, got {self.min_hits}")
+
+
+@dataclass
+class ChunkedPrefillConfig:
+    """Serving chunked-prefill block (``serving.chunked_prefill``;
+    docs/serving.md). Sarathi-Serve-style admission: prompt suffixes are
+    split into ``chunk_size``-token chunks run one per scheduler step,
+    interleaved with decode — active slots never stall behind a long prompt
+    for more than one chunk.
+
+    - ``chunk_size``: tokens per chunk; must be a power of two (the
+      remainder runs as one power-of-two-bucketed padded tail segment, so
+      the compiled chunk-program set is {chunk_size, chunk_size/2, ...} — a
+      handful of stable programs, never one per prompt length).
+    - ``chunks_per_step``: prefill chunks advanced per scheduler step across
+      all admitting requests (decode stall bound).
+    """
+
+    enabled: bool = False
+    chunk_size: int = 64
+    chunks_per_step: int = 1
+
+    def __post_init__(self):
+        c = self.chunk_size
+        if c < 1 or (c & (c - 1)) != 0:
+            raise DeepSpeedConfigError(
+                f"serving.chunked_prefill.chunk_size must be a power of two, got {c}")
+        if self.chunks_per_step < 1:
+            raise DeepSpeedConfigError(
+                f"serving.chunked_prefill.chunks_per_step must be >= 1, "
+                f"got {self.chunks_per_step}")
+
+
+@dataclass
+class ServingConfig:
+    """Serving-engine block (``serving``; consumed by
+    ``deepspeed_tpu.inference.ServingEngine``, docs/serving.md)."""
+
+    n_slots: int = 8
+    max_seq_len: int = 0  # 0 = the engine's sequence budget
+    min_prefill_bucket: int = 16
+    seed: int = 0
+    jsonl_path: str = ""
+    watchdog_mode: str = "warn"
+    prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+    chunked_prefill: ChunkedPrefillConfig = field(default_factory=ChunkedPrefillConfig)
+
+    def __post_init__(self):
+        if isinstance(self.prefix_cache, dict):
+            self.prefix_cache = _build(PrefixCacheConfig, self.prefix_cache)
+        if isinstance(self.chunked_prefill, dict):
+            self.chunked_prefill = _build(ChunkedPrefillConfig, self.chunked_prefill)
+        if self.watchdog_mode not in ("off", "warn", "raise"):
+            raise DeepSpeedConfigError(
+                f"serving.watchdog_mode must be off|warn|raise, "
+                f"got {self.watchdog_mode!r}")
+
+
+@dataclass
 class CurriculumConfig:
     """reference: runtime/data_pipeline/curriculum_scheduler.py:8."""
 
@@ -355,6 +459,7 @@ class DeepSpeedConfig:
     wandb: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     curriculum_learning: CurriculumConfig = field(default_factory=CurriculumConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = field(default_factory=ProgressiveLayerDropConfig)
     eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
@@ -400,6 +505,7 @@ class DeepSpeedConfig:
             wandb=_build(MonitorBackendConfig, _sub(d, C.MONITOR_WANDB)),
             csv_monitor=_build(MonitorBackendConfig, _sub(d, C.MONITOR_CSV)),
             telemetry=_build(TelemetryConfig, _sub(d, C.TELEMETRY)),
+            serving=_build(ServingConfig, _sub(d, C.SERVING)),
             curriculum_learning=_build(CurriculumConfig, _sub(d, C.CURRICULUM_LEARNING)),
             progressive_layer_drop=_build(ProgressiveLayerDropConfig, _sub(d, C.PROGRESSIVE_LAYER_DROP)),
             eigenvalue=_build(EigenvalueConfig, _sub(d, "eigenvalue")),

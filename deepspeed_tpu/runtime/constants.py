@@ -35,6 +35,7 @@ MONITOR_TENSORBOARD = "tensorboard"
 MONITOR_WANDB = "wandb"
 MONITOR_CSV = "csv_monitor"
 TELEMETRY = "telemetry"
+SERVING = "serving"
 CURRICULUM_LEARNING = "curriculum_learning"
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 ELASTICITY = "elasticity"

@@ -9,6 +9,8 @@ Pretty-prints, for CI logs and bench triage:
     signatures that triggered them) with stable-path violations flagged,
   * request latency percentiles (TTFT / per-output-token) from ``request``
     events,
+  * the serving prefix-cache table (hit rate, tokens reused, pool occupancy,
+    resident entries) when the run's snapshot carries one,
   * the last registry ``snapshot`` event, if the run emitted one.
 
 Pure stdlib + host-side: safe to run anywhere the JSONL landed (no jax
@@ -123,6 +125,31 @@ def summarize(events: list[dict], top: int = 10) -> str:
     for ev in events:
         if ev.get("type") == "snapshot":
             snap = ev
+
+    # -- prefix cache ---------------------------------------------------
+    pc = snap.get("prefix_cache") if snap is not None else None
+    if pc:
+        total = pc.get("hits", 0) + pc.get("misses", 0)
+        lines.append(
+            f"prefix cache ({pc.get('used_slots', 0)}/{pc.get('n_slots', 0)} "
+            f"pool slots, block {pc.get('block', '?')}, "
+            f"policy {pc.get('insert_policy', '?')}):")
+        lines.append(
+            f"  lookups={total} hit_rate={pc.get('hit_rate', 0.0):.1%} "
+            f"tokens_reused={pc.get('tokens_reused', 0)} "
+            f"inserts={pc.get('inserts', 0)} evictions={pc.get('evictions', 0)} "
+            f"insert_skips={pc.get('insert_skips', 0)}")
+        entries = pc.get("entries", [])
+        if entries:
+            lines.append(f"  {'length':>8} {'hits':>6} {'refs':>6} {'pool_slot':>10}")
+            for e in entries[:top]:
+                lines.append(
+                    f"  {e['length']:>8} {e['hits']:>6} {e['refs']:>6} "
+                    f"{e['pool_slot']:>10}")
+            if len(entries) > top:
+                lines.append(f"  ... +{len(entries) - top} more entries")
+        lines.append("")
+
     if snap is not None:
         metrics = snap.get("metrics", {})
         lines.append("last registry snapshot:")

@@ -36,6 +36,28 @@ if not os.environ.get("DSTPU_TEST_NO_XLA_CACHE"):
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+@pytest.fixture(scope="session")
+def tiny_serving_engine():
+    """ONE tiny InferenceEngine shared by every serving-side test module
+    (test_serving, test_prefix_cache, ...). The suite is compile-bound: a
+    single model config means every ServingEngine built on top of it reuses
+    the same XLA programs (decode/prefill/chunk shapes hash identically into
+    tests/.xla_cache), so new serving tests cost execution time, not compile
+    time. Keep this config EXACTLY in sync across tests — a drifted vocab or
+    hidden size forks the whole cached program set."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=97, max_seq_len=128, num_layers=2, num_heads=4,
+        hidden_size=32, dtype=jnp.float32, loss_chunk_size=0,
+        decode_attn="xla", pos_emb="rotary",
+    )
+    return InferenceEngine(model=Model(cfg), config={"dtype": "fp32"})
+
+
 @pytest.fixture
 def mesh8():
     from deepspeed_tpu.comm.mesh import MeshConfig, build_mesh

@@ -1,0 +1,246 @@
+"""Prefix-cache KV reuse + chunked prefill (inference/prefix_cache.py,
+inference/serving.py).
+
+The contract under test: greedy token streams are IDENTICAL with the prefix
+cache and/or chunked prefill on vs off (the features change the prompt-side
+schedule, never the tokens); the host-side index evicts LRU-only-unreferenced
+entries; and the whole feature set stays inside the engine's compile-
+stability envelope (watchdog ``raise`` passes over a ragged mixed workload).
+
+All engine tests share the session-scoped ``tiny_serving_engine`` fixture
+(tests/conftest.py) so every ServingEngine here reuses the suite's cached
+XLA programs. Feature configs are likewise standardized (chunk_size 16,
+pool 4x64, block 8) — one chunk width, one fetch/store shape.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.inference import Request, ServingEngine
+from deepspeed_tpu.inference.prefix_cache import PrefixIndex
+
+FEATURES = {
+    "prefix_cache": {"enabled": True, "n_slots": 4, "block": 8,
+                     "max_prefix_len": 64},
+    "chunked_prefill": {"enabled": True, "chunk_size": 16},
+}
+
+
+def _shared_prefix_prompts(n, prefix_len=40, seed=0, vocab=97):
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(0, vocab, size=prefix_len).astype(np.int32)
+    return [np.concatenate([shared, rng.integers(0, vocab, size=5 + 2 * i)
+                            .astype(np.int32)]) for i in range(n)], shared
+
+
+# ---------------------------------------------------------------------------
+# host-side index (no jax, no device)
+# ---------------------------------------------------------------------------
+
+def test_index_longest_match_and_block_granularity():
+    idx = PrefixIndex(n_slots=4, block=4)
+    toks = list(range(20))
+    res = idx.insert(toks, max_len=19)  # 4 blocks -> entry length 16
+    assert res.created and res.entry.length == 16
+    # longest match wins over a shorter nested entry
+    short = idx.insert(toks[:8] + [99] * 8, max_len=10)  # diverges after 8
+    assert short.created and short.entry.length == 8
+    hit = idx.lookup(toks + [7], max_len=19)
+    assert hit is res.entry and hit.hits == 1
+    hit2 = idx.lookup(toks[:8] + [99] * 12, max_len=19)
+    assert hit2 is short.entry
+    # shorter-than-one-block prompts never match or insert
+    assert idx.lookup(toks[:3], max_len=3) is None
+    assert idx.insert(toks[:3], max_len=3).entry is None
+
+
+def test_index_lru_eviction_prefers_least_recently_used():
+    idx = PrefixIndex(n_slots=2, block=4)
+    a = idx.insert([1] * 8, 8).entry
+    b = idx.insert([2] * 8, 8).entry
+    assert idx.used_slots == 2
+    idx.lookup([1] * 8 + [5], 9)  # touch a: b becomes LRU
+    res = idx.insert([3] * 8, 8)
+    assert res.created and res.evicted is b and idx.evictions == 1
+    assert idx.lookup([2] * 9, 9) is None  # b gone
+    assert idx.lookup([1] * 9, 9) is a  # a survived
+    assert res.entry.pool_slot == b.pool_slot  # slot recycled
+
+
+def test_index_refcount_blocks_eviction():
+    idx = PrefixIndex(n_slots=1, block=4)
+    a = idx.insert([1] * 8, 8).entry
+    idx.acquire(a)
+    res = idx.insert([2] * 8, 8)  # pool full, only entry is in use
+    assert res.entry is None and "in-use" in res.skipped
+    assert idx.insert_skips == 1 and idx.used_slots == 1
+    idx.release(a)
+    res2 = idx.insert([2] * 8, 8)  # now evictable
+    assert res2.created and res2.evicted is a
+
+
+def test_index_compaction_bounds_trie_memory():
+    """A stream of never-cached unique prompts (min_hits bar never met) must
+    not grow the trie without bound — compaction rebuilds it from the
+    resident entries' paths."""
+    idx = PrefixIndex(n_slots=2, block=4, insert_policy="min_hits", min_hits=2)
+    kept = idx.insert([7] * 8, 8)
+    kept = idx.insert([7] * 8, 8)  # second walk meets the bar -> cached
+    assert kept.created
+    for i in range(2000):  # unique one-off prompts, never cached
+        idx.insert([i, i + 1, i + 2, i + 3] * 3, 12)
+    assert idx.compactions >= 1
+    assert idx._n_nodes <= 1024 + 3  # bounded (cap + one walk's overshoot)
+    # the cached entry survived compaction and still matches
+    assert idx.lookup([7] * 8 + [1], 9) is kept.entry
+
+
+def test_index_min_hits_policy_caches_shared_prefixes_only():
+    idx = PrefixIndex(n_slots=4, block=4, insert_policy="min_hits", min_hits=2)
+    assert idx.insert([1] * 12, 12).entry is None  # first traversal: skip
+    res = idx.insert([1] * 8 + [9] * 4, 12)  # shares 8 tokens -> count 2
+    assert res.created and res.entry.length == 8  # cached at the SHARED depth
+    assert idx.lookup([1] * 8 + [3], 9) is res.entry
+
+
+# ---------------------------------------------------------------------------
+# end-to-end greedy parity
+# ---------------------------------------------------------------------------
+
+def test_greedy_parity_prefix_cache_on_vs_off(tiny_serving_engine):
+    """Two waves of shared-prefix requests: wave 2 admits through real cache
+    hits, and every stream is tokenwise identical to the feature-off path
+    (which itself is generate-parity-tested in test_serving)."""
+    eng = tiny_serving_engine
+    prompts, _ = _shared_prefix_prompts(4, seed=21)
+    refs = [eng.generate(p[None], max_new_tokens=6)[0] for p in prompts]
+    srv = ServingEngine(eng, n_slots=2, max_seq_len=128, config=FEATURES)
+    r1 = srv.serve([Request(uid=i, prompt=p, max_new_tokens=6)
+                    for i, p in enumerate(prompts[:2])])
+    r2 = srv.serve([Request(uid=2 + i, prompt=p, max_new_tokens=6)
+                    for i, p in enumerate(prompts[2:])])
+    for i in range(2):
+        np.testing.assert_array_equal(r1[i].tokens, refs[i])
+        np.testing.assert_array_equal(r2[2 + i].tokens, refs[2 + i])
+    stats = srv.prefix_cache_stats()
+    assert stats["hits"] >= 2  # wave 2 must reuse wave 1's prefix
+    assert stats["tokens_reused"] >= 2 * 40
+    # reused tokens surface per request too
+    assert all(r2[2 + i].prefix_hit_tokens >= 40 for i in range(2))
+    snap = srv.telemetry_snapshot()
+    assert snap["prefix_cache"]["hit_rate"] > 0
+    assert snap["metrics"]["counters"]["serving/prefix_hits"] == stats["hits"]
+
+
+def test_greedy_parity_chunked_vs_one_shot(tiny_serving_engine):
+    """Chunked prefill (no prefix cache) emits the same tokens as the
+    one-shot bucketed prefill for the same request set."""
+    eng = tiny_serving_engine
+    rng = np.random.default_rng(22)
+    prompts = [rng.integers(0, 97, size=s).astype(np.int32)
+               for s in (5, 19, 37, 50)]
+    reqs = lambda: [Request(uid=i, prompt=p, max_new_tokens=5)
+                    for i, p in enumerate(prompts)]
+    base = ServingEngine(eng, n_slots=2, max_seq_len=128)
+    chunked = ServingEngine(eng, n_slots=2, max_seq_len=128,
+                            config={"chunked_prefill": FEATURES["chunked_prefill"]})
+    rb, rc = base.serve(reqs()), chunked.serve(reqs())
+    for i in range(len(prompts)):
+        np.testing.assert_array_equal(rc[i].tokens, rb[i].tokens)
+    # the chunk-program set is width-keyed and each compiled exactly once
+    counts = chunked.compile_counts()
+    assert counts["decode"] == 1
+    assert set(counts["chunk_prefill"]) == {16}
+    assert all(v == 1 for v in counts["chunk_prefill"].values())
+
+
+def test_refcount_protects_in_flight_prefix_e2e(tiny_serving_engine):
+    """A 1-slot pool whose only entry backs a still-decoding request: a
+    competing prefix cannot evict it, the insert is skipped, and the
+    protected request's stream is unperturbed."""
+    eng = tiny_serving_engine
+    rng = np.random.default_rng(23)
+    shared = rng.integers(0, 97, size=16).astype(np.int32)
+    other = rng.integers(0, 97, size=16).astype(np.int32)
+    pA = np.concatenate([shared, rng.integers(0, 97, size=5).astype(np.int32)])
+    pB = np.concatenate([shared, rng.integers(0, 97, size=7).astype(np.int32)])
+    pC = np.concatenate([other, rng.integers(0, 97, size=6).astype(np.int32)])
+    ref_b = eng.generate(pB[None], max_new_tokens=30)[0]
+    srv = ServingEngine(
+        eng, n_slots=2, max_seq_len=128,
+        config={"prefix_cache": {"enabled": True, "n_slots": 1, "block": 8,
+                                 "max_prefix_len": 32},
+                "chunked_prefill": FEATURES["chunked_prefill"]})
+    srv.submit(Request(uid=0, prompt=pA, max_new_tokens=2))
+    srv.drain()  # caches shared[:16]
+    assert srv.prefix_cache_stats()["used_slots"] == 1
+    srv.submit(Request(uid=1, prompt=pB, max_new_tokens=30))
+    while srv.n_active == 0:  # admit B through the cached prefix
+        srv.step(now=float("inf"))
+    st = srv.prefix_cache_stats()
+    assert st["hits"] >= 1 and st["entries"][0]["refs"] == 1
+    # C completes while B is mid-decode; its prefix wants the only pool slot
+    srv.submit(Request(uid=2, prompt=pC, max_new_tokens=2))
+    while 2 not in srv._results:
+        srv.step(now=float("inf"))
+    st = srv.prefix_cache_stats()
+    assert st["insert_skips"] >= 1 and st["evictions"] == 0
+    assert st["used_slots"] == 1 and st["entries"][0]["length"] == 16
+    res = srv.drain()
+    np.testing.assert_array_equal(res[1].tokens, ref_b)
+    assert srv.prefix_cache_stats()["entries"][0]["refs"] == 0  # released
+
+
+def test_watchdog_raise_over_ragged_mixed_workload(tiny_serving_engine):
+    """Acceptance: with BOTH features on and the watchdog in ``raise`` mode,
+    a ragged workload (distinct prompt lengths, sampling params, staggered
+    arrivals, repeated waves over reused slots and cache hits) introduces NO
+    unstable recompiles — decode stays ONE program, every chunk width /
+    prefix copy / prefill bucket compiles exactly once."""
+    eng = tiny_serving_engine
+    srv = ServingEngine(eng, n_slots=4, max_seq_len=128,
+                        config={**FEATURES, "watchdog_mode": "raise"})
+    rng = np.random.default_rng(24)
+    shared = rng.integers(0, 97, size=24).astype(np.int32)
+
+    def wave(base_uid):
+        reqs = []
+        for i in range(6):
+            tail = rng.integers(0, 97, size=3 + 5 * i).astype(np.int32)
+            prompt = (np.concatenate([shared, tail]) if i % 2 == 0
+                      else rng.integers(0, 97, size=4 + 7 * i).astype(np.int32))
+            reqs.append(Request(
+                uid=base_uid + i, prompt=prompt, max_new_tokens=3 + i,
+                temperature=float(i % 3) * 0.7, top_k=int(i % 4) * 5,
+                top_p=1.0 - 0.05 * (i % 2), arrival_time=0.01 * i))
+        return reqs
+
+    res = srv.serve(wave(0))
+    res.update(srv.serve(wave(100)))  # second wave: hits + slot reuse
+    assert len(res) == 12
+    counts = srv.compile_counts()
+    assert counts["decode"] == 1, counts
+    assert all(v == 1 for v in counts["chunk_prefill"].values()), counts
+    assert counts.get("prefix_fetch", 0) <= 1
+    assert counts.get("prefix_store", 0) <= 1
+    table = {r["name"]: r for r in srv.telemetry.watchdog.compile_table()}
+    assert all(r["compiles"] <= 1 for r in table.values()), table
+    assert srv.prefix_cache_stats()["hits"] >= 1  # the hit path really ran
+
+
+def test_report_renders_prefix_cache_table(tiny_serving_engine, tmp_path):
+    """The JSONL snapshot carries the prefix-cache stats and the report CLI
+    renders them as a table."""
+    from deepspeed_tpu.telemetry.report import load_events, summarize
+
+    eng = tiny_serving_engine
+    jsonl = tmp_path / "serve.jsonl"
+    prompts, _ = _shared_prefix_prompts(2, prefix_len=16, seed=25)
+    srv = ServingEngine(eng, n_slots=2, max_seq_len=128,
+                        config={**FEATURES, "jsonl_path": str(jsonl)})
+    srv.serve([Request(uid=i, prompt=p, max_new_tokens=3)
+               for i, p in enumerate(prompts)])
+    srv.telemetry_snapshot()
+    out = summarize(load_events(str(jsonl)))
+    assert "prefix cache (" in out
+    assert "tokens_reused=" in out
+    assert "pool_slot" in out  # the entries table rendered

@@ -24,13 +24,11 @@ from deepspeed_tpu.models.transformer import Model, TransformerConfig
 
 
 @pytest.fixture(scope="module")
-def engine():
-    cfg = TransformerConfig(
-        vocab_size=97, max_seq_len=128, num_layers=2, num_heads=4,
-        hidden_size=32, dtype=jnp.float32, loss_chunk_size=0,
-        decode_attn="xla", pos_emb="rotary",
-    )
-    return InferenceEngine(model=Model(cfg), config={"dtype": "fp32"})
+def engine(tiny_serving_engine):
+    # the shared session-scoped tiny model (tests/conftest.py) — every
+    # serving test module decodes the same params through the same cached
+    # XLA programs
+    return tiny_serving_engine
 
 
 def _prompts(sizes, seed=0, vocab=97):
@@ -121,6 +119,22 @@ def test_decode_compiles_once_across_mixed_workload(engine):
     # bucketed prefill: one compile per power-of-two bucket, not per length
     assert all(v == 1 for v in counts["prefill"].values()), counts
     assert len(counts["prefill"]) < 8
+
+
+def test_admission_not_blocked_by_future_head(engine):
+    """A queue head whose arrival_time is still in the future must not block
+    admission of later-submitted requests that have already arrived — the
+    scheduler scans for the earliest ARRIVED request, not queue[0]."""
+    srv = ServingEngine(engine, n_slots=2, max_seq_len=128)
+    pa, pb = _prompts([6, 9], seed=11)
+    srv.submit(Request(uid=0, prompt=pa, max_new_tokens=4, arrival_time=1e6))
+    srv.submit(Request(uid=1, prompt=pb, max_new_tokens=4, arrival_time=0.0))
+    srv.step(now=1.0)
+    assert srv.n_active == 1  # uid 1 admitted past the future-dated head
+    assert [r.uid for r in srv._queue] == [0]
+    res = srv.drain()  # drain ignores arrival times: uid 0 completes too
+    np.testing.assert_array_equal(res[1].tokens, engine.generate(pb[None], 4)[0])
+    assert len(res[0].tokens) == 4  # the future-dated head still completed
 
 
 def test_greedy_rows_immune_to_neighbour_sampling(engine):
