@@ -1,19 +1,37 @@
-"""Sharded, multi-host-safe checkpoint save/load.
+"""Sharded, multi-host-safe, atomic checksummed checkpoint save/load.
 
 Layout (replaces the reference's per-rank ``mp_rank_XX_model_states.pt`` +
 ``zero_pp_rank_X_*_optim_states.pt`` files, runtime/engine.py:2877/:2467):
 
   <ckpt_dir>/
-    manifest.json            — leaf index: shape/dtype + shard file table
+    manifest.json            — leaf index: shape/dtype + shard file table +
+                               per-file crc32 digests (the commit record)
     <leafkey>.full.npy       — fully-replicated leaves (one writer)
     <leafkey>.shard000.npy   — one file per DISTINCT global shard
+
+Atomicity & integrity (docs/resilience.md):
+  * single-process saves stage into ``<ckpt_dir>.tmp`` — every file is
+    fsync'd, the manifest is written last, the staging dir is fsync'd, and
+    only then is it renamed into place. A crash at ANY point leaves either
+    the previous checkpoint intact or a ``.tmp`` directory that loading
+    ignores and the next save reclaims — never a half-visible checkpoint;
+  * every array file's crc32 lands in the manifest; ``verify_checkpoint``
+    (run by default on load) re-digests the files and raises a typed
+    ``CheckpointCorruptError`` on any mismatch/missing file, so a torn or
+    bit-flipped checkpoint is detected *before* state is touched;
+  * missing directory/manifest raises ``CheckpointNotFoundError`` (cold
+    start) — distinguishable from corruption (fall back to an older tag).
 
 Multi-host correctness (VERDICT r02 weak #3):
   * each process writes ONLY shards whose owner device is local, deduped by
     replica (the devices→indices map is deterministic, so the assignment is
-    agreed without communication);
-  * the manifest + 'latest' tag are written by process 0 alone — no two
-    processes ever write the same file.
+    agreed without communication); files land via per-file tmp + rename
+    (no whole-dir staging: with a non-shared filesystem a directory rename
+    on one host cannot commit the others) and the manifest — written by
+    process 0 alone, after the cross-process barrier — stays the commit
+    record. Digests cover process 0's own files only, and ``verify``
+    downgrades to a manifest/existence check on multi-process runs;
+  * the 'latest' tag is written by process 0 after the manifest is durable.
 
 Loading is topology-free: ``jax.make_array_from_callback`` against the
 *current* shardings pulls exactly the slices each device needs from the
@@ -25,21 +43,45 @@ tp×fsdp=2×4 — this subsumes the reference's elastic re-partitioning
 ``async_save=True`` returns a handle: device→host transfers happen inline
 (consistent snapshot), file writes drain on a background thread — the
 reference's Nebula-style async tier (runtime/checkpoint_engine/).
+
+Fault-injection: every file write is guarded by
+``resilience.faults.maybe_io_error`` — an installed injector can fail the
+Nth write with ``OSError`` to prove the atomicity story in tests.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import threading
+import zlib
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
+from ..resilience.errors import CheckpointCorruptError, CheckpointNotFoundError
+from ..resilience.faults import maybe_io_error
+
 PyTree = Any
 _SEP = "::"
 _MANIFEST = "manifest.json"
+_STAGE_SUFFIX = ".tmp"
+
+_launder_jit = None
+
+
+def _launder_fn():
+    """Module-level undonated jit identity (CPU laundering pass): a fresh
+    ``jax.jit(lambda xs: xs)`` per load would retrace + recompile the whole
+    state tree on EVERY load_checkpoint — including every guardrail rewind
+    and every corrupt-fallback candidate. One shared wrapper compiles once
+    per distinct shape set."""
+    global _launder_jit
+    if _launder_jit is None:
+        _launder_jit = jax.jit(lambda xs: xs)
+    return _launder_jit
 
 
 def _flatten_with_paths(tree) -> dict[str, Any]:
@@ -105,6 +147,65 @@ class SaveHandle:
         return True
 
 
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_durable(path: str, data: bytes) -> None:
+    """Guarded durable write: fault-injection hook, then write + fsync."""
+    maybe_io_error(path)
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+class _Crc32Writer:
+    """File-object shim that crc32s bytes as they stream through.
+    ``np.save`` writes through it in bounded chunks (it takes the buffered
+    non-fileobj path), so the save never materializes a second full copy
+    of a shard — the same RSS property verify_checkpoint's chunked read
+    keeps on the load side."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+
+    def write(self, b):
+        self.crc = zlib.crc32(b, self.crc)
+        return self._f.write(b)
+
+
+def _save_array_durable(path: str, arr: np.ndarray) -> int:
+    """Guarded durable ``np.save`` returning the crc32 of the exact bytes
+    written (fault-injection hook, then streamed write + fsync)."""
+    maybe_io_error(path)
+    with open(path, "wb") as f:
+        w = _Crc32Writer(f)
+        np.save(w, arr)
+        f.flush()
+        os.fsync(f.fileno())
+    return w.crc
+
+
+def write_latest(path: str, tag: str) -> None:
+    """Durably (re)point a 'latest' tag file: tmp + fsync + rename +
+    directory fsync, so a crash never surfaces a truncated or lost tag.
+    Shared by save finalize, the corrupt-fallback repoint in
+    ``engine.load_checkpoint``, and the orbax engine."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(tag)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_path(os.path.dirname(os.path.abspath(path)) or ".")
+
+
 def save_checkpoint(
     ckpt_dir: str,
     state: PyTree,
@@ -114,13 +215,20 @@ def save_checkpoint(
 ) -> SaveHandle:
     """``latest=(path, tag)`` writes the tag file AFTER the manifest is
     durable (process 0 only) — a crash mid-save never leaves 'latest'
-    pointing at a torn checkpoint."""
-    os.makedirs(ckpt_dir, exist_ok=True)
+    pointing at a torn checkpoint. Single-process saves additionally stage
+    the whole checkpoint in ``<ckpt_dir>.tmp`` and rename it into place at
+    finalize (see module docstring)."""
+    single = jax.process_count() == 1
+    stage_dir = ckpt_dir + _STAGE_SUFFIX if single else ckpt_dir
+    if single and os.path.exists(stage_dir):
+        shutil.rmtree(stage_dir)  # a crashed save's leftovers
+    os.makedirs(stage_dir, exist_ok=True)
     flat = _flatten_with_paths(state)
     proc = jax.process_index()
     local_devices = {d.id for d in jax.local_devices()}
 
-    manifest = {"leaves": {}, "client_state": client_state or {}, "format": 2}
+    manifest = {"leaves": {}, "client_state": client_state or {}, "format": 3,
+                "checksums": {}}
     to_write: list[tuple[str, np.ndarray]] = []  # (fname, host array)
 
     for key, leaf in flat.items():
@@ -151,14 +259,66 @@ def save_checkpoint(
         manifest["leaves"][key] = entry
 
     def _write_files(errors):
+        # the crc is computed over the exact bytes written; the manifest
+        # (written at finalize, AFTER this thread is joined) carries it
         try:
             for fname, arr in to_write:
-                tmp = os.path.join(ckpt_dir, fname + ".tmp")
-                with open(tmp, "wb") as f:  # np.save would append '.npy' to the tmp name
-                    np.save(f, arr)
-                os.replace(tmp, os.path.join(ckpt_dir, fname))
+                if single:
+                    manifest["checksums"][fname] = _save_array_durable(
+                        os.path.join(stage_dir, fname), arr)
+                else:
+                    # in-place multi-host path: per-file tmp + atomic rename
+                    tmp = os.path.join(stage_dir, fname + ".tmp")
+                    manifest["checksums"][fname] = _save_array_durable(tmp, arr)
+                    os.replace(tmp, os.path.join(stage_dir, fname))
         except Exception as e:  # surfaced on handle.wait()
             errors.append(e)
+
+    def _commit_stage():
+        """Rename the staged dir into place. When the target already exists
+        (a re-save over the same tag, or sidecar files like the NVMe tier's
+        landed first), the OLD manifest is unlinked FIRST, then staged
+        entries are moved in one by one with the new manifest LAST. The
+        manifest is the commit record, so every crash window is safe: before
+        the unlink the old checkpoint is intact; between unlink and the
+        final move the dir has no manifest and load treats it as not-found
+        (falling back to another tag) — never a manifest whose digests
+        cover a half-replaced file set, which would read as CORRUPT and
+        mask the older intact tags behind a scarier error."""
+        if not os.path.exists(ckpt_dir):
+            os.rename(stage_dir, ckpt_dir)
+        else:
+            old_manifest = os.path.join(ckpt_dir, _MANIFEST)
+            if os.path.exists(old_manifest):
+                os.unlink(old_manifest)
+                _fsync_path(ckpt_dir)
+            names = [n for n in os.listdir(stage_dir) if n != _MANIFEST]
+            for name in names:
+                src = os.path.join(stage_dir, name)
+                if os.path.exists(src):
+                    os.replace(src, os.path.join(ckpt_dir, name))
+            # rename durability lives in the directory HOLDING the entries:
+            # the data renames must hit disk before the manifest's rename can
+            # declare them, and the manifest rename needs its own fsync —
+            # otherwise power loss can persist the manifest while losing a
+            # data rename, the torn-but-manifested state this ordering
+            # exists to rule out
+            _fsync_path(ckpt_dir)
+            msrc = os.path.join(stage_dir, _MANIFEST)
+            if os.path.exists(msrc):
+                os.replace(msrc, os.path.join(ckpt_dir, _MANIFEST))
+            _fsync_path(ckpt_dir)
+            os.rmdir(stage_dir)
+            # drop .npy files the previous save of this tag wrote but the
+            # new layout no longer references (topology/leaf-set change) —
+            # verify only checks manifest-listed files, so orphans would
+            # otherwise accumulate invisibly forever. Sidecars (nvme
+            # subdirs, non-.npy files) are untouched.
+            staged = set(names)
+            for name in os.listdir(ckpt_dir):
+                if name.endswith(".npy") and name not in staged:
+                    os.unlink(os.path.join(ckpt_dir, name))
+        _fsync_path(os.path.dirname(os.path.abspath(ckpt_dir)) or ".")
 
     def _finalize():
         # manifest + 'latest' declare the checkpoint complete, so EVERY
@@ -169,16 +329,15 @@ def save_checkpoint(
 
             multihost_utils.sync_global_devices(f"ckpt_save:{ckpt_dir}")
         if proc == 0:
-            tmp = os.path.join(ckpt_dir, _MANIFEST + ".tmp")
-            with open(tmp, "w") as f:
-                json.dump(manifest, f, indent=1)
-            os.replace(tmp, os.path.join(ckpt_dir, _MANIFEST))
-            if latest is not None:
-                lpath, tag = latest
-                ltmp = lpath + ".tmp"
-                with open(ltmp, "w") as f:
-                    f.write(tag)
-                os.replace(ltmp, lpath)
+            data = json.dumps(manifest, indent=1).encode()
+            tmp = os.path.join(stage_dir, _MANIFEST + ".tmp")
+            _write_durable(tmp, data)
+            os.replace(tmp, os.path.join(stage_dir, _MANIFEST))
+            _fsync_path(stage_dir)
+        if single:
+            _commit_stage()
+        if proc == 0 and latest is not None:
+            write_latest(*latest)
 
     if async_save:
         errors: list = []
@@ -221,12 +380,114 @@ def _read_slice(ckpt_dir: str, entry: dict, index: tuple) -> np.ndarray:
     return out
 
 
-def load_checkpoint(ckpt_dir: str, state_like: PyTree, shardings: Optional[PyTree] = None):
+def read_manifest(ckpt_dir: str) -> dict:
+    """Parse a checkpoint's manifest with typed failures: missing directory
+    or manifest → ``CheckpointNotFoundError`` (cold start — nothing was ever
+    committed here); unparseable manifest → ``CheckpointCorruptError``."""
+    path = os.path.join(ckpt_dir, _MANIFEST)
+    if not os.path.isdir(ckpt_dir):
+        raise CheckpointNotFoundError(
+            f"no checkpoint directory at {ckpt_dir}", path=ckpt_dir)
+    if not os.path.exists(path):
+        raise CheckpointNotFoundError(
+            f"checkpoint at {ckpt_dir} has no {_MANIFEST} (save never "
+            f"committed)", path=path)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint manifest {path}: {e}", path=path) from e
+
+
+def _manifest_files(manifest: dict) -> list[str]:
+    files = []
+    for entry in manifest.get("leaves", {}).values():
+        if "file" in entry:
+            files.append(entry["file"])
+        else:
+            files.extend(sh["file"] for sh in entry.get("shards", []))
+    return files
+
+
+def verify_checkpoint(ckpt_dir: str, manifest: Optional[dict] = None,
+                      digests: bool = True) -> dict:
+    """Integrity check: every manifest-referenced file exists and (when the
+    manifest carries checksums and ``digests`` is true) matches its recorded
+    crc32. Raises ``CheckpointCorruptError`` on the first violation; returns
+    the manifest on success.
+
+    Digest verification reads each file fully — at the scale where that
+    matters, pass ``digests=False`` to keep the load's mmap'd partial reads
+    (existence is still checked). On multi-process runs only locally-present
+    files can be checked; process 0's digests cover its own files."""
+    if manifest is None:
+        manifest = read_manifest(ckpt_dir)
+    crcs = manifest.get("checksums", {}) if digests else {}
+    for fname in _manifest_files(manifest):
+        path = os.path.join(ckpt_dir, fname)
+        if not os.path.exists(path):
+            if jax.process_count() > 1:
+                continue  # non-shared fs: another host's shard
+            raise CheckpointCorruptError(
+                f"checkpoint {ckpt_dir} is torn: missing {fname}", path=path)
+        want = crcs.get(fname)
+        if want is not None:
+            got = 0
+            with open(path, "rb") as f:
+                # chunked: a single read() would spike host RSS by the
+                # largest shard's size during the default-on pre-load pass
+                while chunk := f.read(1 << 20):
+                    got = zlib.crc32(chunk, got)
+            if got != want:
+                raise CheckpointCorruptError(
+                    f"checkpoint {ckpt_dir} is corrupt: {fname} crc32 "
+                    f"{got:#010x} != recorded {want:#010x}", path=path)
+    return manifest
+
+
+def find_checkpoints(root: str) -> list[str]:
+    """Tags under ``root`` that carry a manifest (i.e. committed saves),
+    newest first — the fallback scan order for a torn 'latest' and the
+    keep_last_k pruning order. 'Newest' means the manifest's recorded
+    ``global_steps`` when present, manifest mtime as tiebreak: mtimes
+    alone collide within filesystem timestamp granularity (or lie after
+    clock skew), which could make the fallback silently prefer an OLDER
+    intact tag. A tag whose manifest is unreadable sorts last (load will
+    surface it as corrupt if the scan ever reaches it). Staging leftovers
+    (``*.tmp``) are never listed."""
+    if not os.path.isdir(root):
+        return []
+    tags = []
+    for name in os.listdir(root):
+        if name.endswith(_STAGE_SUFFIX):
+            continue
+        mpath = os.path.join(root, name, _MANIFEST)
+        if not os.path.isfile(mpath):
+            continue
+        steps = -1
+        try:
+            with open(mpath) as f:
+                cs = json.load(f).get("client_state", {})
+            steps = int(cs.get("global_steps", -1))
+        except (OSError, ValueError, TypeError):
+            steps = -2
+        tags.append((steps, os.path.getmtime(mpath), name))
+    return [name for _, _, name in sorted(tags, reverse=True)]
+
+
+def load_checkpoint(ckpt_dir: str, state_like: PyTree,
+                    shardings: Optional[PyTree] = None, verify: bool = True):
     """Restore into the structure of ``state_like``, resharded onto the
     CURRENT shardings (missing leaves keep their current value — the
-    reference's load_module_strict=False)."""
-    with open(os.path.join(ckpt_dir, _MANIFEST)) as f:
-        manifest = json.load(f)
+    reference's load_module_strict=False). ``verify`` digests every file
+    against the manifest first (single-process; see verify_checkpoint) so a
+    torn checkpoint raises ``CheckpointCorruptError`` before any state is
+    touched."""
+    manifest = read_manifest(ckpt_dir)
+    if verify:
+        verify_checkpoint(ckpt_dir, manifest=manifest,
+                          digests=jax.process_count() == 1)
 
     flat_like = _flatten_with_paths(state_like)
     flat_shard = _flatten_with_paths(shardings) if shardings is not None else {}
@@ -253,14 +514,30 @@ def load_checkpoint(ckpt_dir: str, state_like: PyTree, shardings: Optional[PyTre
     for path, _ in leaves_paths:
         key = _SEP.join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
         ordered.append(restored[key])
+    if jax.default_backend() == "cpu":
+        # LAUNDER (root cause of the post-load corruption flake): on the CPU
+        # backend, make_array_from_callback / device_put ZERO-COPY the
+        # callback's numpy buffers into the returned jax arrays, and that
+        # backing memory is not reliably pinned for the array's lifetime.
+        # The train step then DONATES its whole state; once the heap churns,
+        # a donated numpy-backed buffer becomes silent use-after-free and a
+        # restored run trains on garbage (reproduced 11/11 with heap churn
+        # between load and step; 0/11 with this pass). An undonated jit
+        # identity re-materializes every leaf into XLA-owned buffers; on
+        # accelerator backends the host->HBM copy already does that, so the
+        # pass is CPU-only.
+        arr_idx = [i for i, a in enumerate(ordered) if isinstance(a, jax.Array)]
+        if arr_idx:
+            laundered = _launder_fn()([ordered[i] for i in arr_idx])
+            for i, a in zip(arr_idx, laundered):
+                ordered[i] = a
     return jax.tree_util.tree_unflatten(treedef, ordered), manifest.get("client_state", {})
 
 
 def consolidate_checkpoint(ckpt_dir: str) -> dict[str, np.ndarray]:
     """Offline: assemble every leaf into a full host array (the reference's
     zero_to_fp32.py consolidation, utils/zero_to_fp32.py:153)."""
-    with open(os.path.join(ckpt_dir, _MANIFEST)) as f:
-        manifest = json.load(f)
+    manifest = read_manifest(ckpt_dir)
     out = {}
     for key, entry in manifest["leaves"].items():
         shape = tuple(entry["shape"])

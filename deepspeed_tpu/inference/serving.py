@@ -64,6 +64,22 @@ programs over sharded state:
                              request→response bookkeeping, and a wall-clock
                              ``serve`` driver.
 
+  * degradation          — production traffic includes requests that must be
+                             refused or abandoned (docs/resilience.md):
+                             per-request deadlines (queued past deadline →
+                             shed; in-flight → cancelled/evicted with the
+                             partial output), a bounded arrival queue with
+                             typed load-shedding, and a per-slot NaN-logit
+                             sentinel computed INSIDE the decode/prefill
+                             programs — a poisoned request is quarantined
+                             (requeued once for a clean replay, then failed)
+                             without touching the rest of the batch, its KV
+                             is never offered to the prefix cache, and a
+                             slot that faults repeatedly is pulled from
+                             rotation. Every transition is a host-side state
+                             change on the existing per-slot arrays: the
+                             ONE-compiled-decode-program contract survives.
+
 Inactive and mid-prefill slots still flow through the decode program
 (static shapes are the whole point); they WRITE at position Smax — the
 cache scatter's ``mode="drop"`` discards the garbage KV — while attending
@@ -88,7 +104,9 @@ from jax.sharding import NamedSharding
 
 from ..models import transformer as tfm
 from ..parallel.sharding import kv_prefix_pool_spec, kv_slot_cache_spec
-from ..runtime.config import ChunkedPrefillConfig, PrefixCacheConfig
+from ..resilience import FaultInjector, RequestRejected
+from ..runtime.config import (ChunkedPrefillConfig, FaultInjectionConfig,
+                              PrefixCacheConfig)
 from ..telemetry import Telemetry
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
@@ -108,7 +126,11 @@ class Request:
     """One generation request. ``arrival_time`` is seconds relative to the
     engine epoch (0.0 = already arrived). step() admits once its clock —
     wall time by default, or the caller's ``now`` — has passed it; drain()
-    ignores it entirely."""
+    ignores it entirely. ``deadline_s`` (seconds after arrival; 0 = the
+    engine's ``default_deadline_s``, which may itself be 0 = none) bounds
+    the request's total latency: past it a queued request is shed
+    (``expired``) and an in-flight one is cancelled/evicted
+    (``deadline_exceeded``) with whatever it produced so far."""
 
     uid: int
     prompt: np.ndarray  # [S] int32
@@ -118,6 +140,7 @@ class Request:
     top_p: float = 1.0  # 1.0 = disabled
     eos_token: Optional[int] = None
     arrival_time: float = 0.0
+    deadline_s: float = 0.0
 
 
 @dataclass
@@ -131,6 +154,14 @@ class RequestResult:
     finish_time: float = 0.0
     slot: int = -1
     prefix_hit_tokens: int = 0  # prompt tokens reused from the prefix cache
+    # degradation outcome (docs/resilience.md): ok | deadline_exceeded |
+    # cancelled | shed_queue_full | expired | failed_nan
+    status: str = "ok"
+    requeues: int = 0  # NaN-quarantine replays this request went through
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
 
     @property
     def ttft(self) -> float:
@@ -152,6 +183,7 @@ class _Slot:
     result: Optional[RequestResult] = None
     tokens: list = field(default_factory=list)
     prefix_entry: object = None  # acquired PrefixEntry released on finish
+    request: Optional[Request] = None  # kept for quarantine requeue/deadline
 
 
 @dataclass
@@ -191,6 +223,18 @@ class ServingEngine:
       chunked_prefill     {enabled, chunk_size, chunks_per_step} — admission
                           chunks interleaved with decode
                           (runtime/config.ChunkedPrefillConfig)
+      max_queue_len       bound on ARRIVED not-yet-admitted requests; excess
+                          arrivals are load-shed with a typed reason
+                          (0 = unbounded; docs/resilience.md)
+      default_deadline_s  deadline applied to requests without their own
+                          (seconds after arrival; 0 = none)
+      quarantine_max_requeues   clean replays granted to a request whose
+                          logits went non-finite before it is failed
+      slot_quarantine_after     consecutive NaN faults in one slot before
+                          that slot is pulled from rotation
+      fault_injection     {enabled, seed, rate, garbage_logits_*} —
+                          deterministic NaN-logit injection
+                          (runtime/config.FaultInjectionConfig)
 
     Telemetry is always on (host-side dict updates per step — decode already
     pays a device call): TTFT/TPOT histograms, queue depth, slot occupancy,
@@ -207,7 +251,8 @@ class ServingEngine:
                  min_prefill_bucket: int | None = None, seed: int | None = None,
                  telemetry: Telemetry | None = None,
                  prefix_cache: PrefixCacheConfig | dict | None = None,
-                 chunked_prefill: ChunkedPrefillConfig | dict | None = None):
+                 chunked_prefill: ChunkedPrefillConfig | dict | None = None,
+                 fault_injection: FaultInjectionConfig | dict | None = None):
         config = dict(config or {})
         n_slots = n_slots if n_slots is not None else config.get("n_slots", 8)
         max_seq_len = max_seq_len if max_seq_len is not None else config.get(
@@ -232,6 +277,18 @@ class ServingEngine:
             cp = ChunkedPrefillConfig(**cp)
         self.prefix_cfg: PrefixCacheConfig = pc
         self.chunk_cfg: ChunkedPrefillConfig = cp
+
+        # -- degradation knobs (docs/resilience.md) ---------------------
+        self.max_queue_len = int(config.get("max_queue_len", 0))
+        self.default_deadline_s = float(config.get("default_deadline_s", 0.0))
+        self.quarantine_max_requeues = int(config.get("quarantine_max_requeues", 1))
+        self.slot_quarantine_after = int(config.get("slot_quarantine_after", 2))
+        fi = (fault_injection if fault_injection is not None
+              else config.get("fault_injection", {}))
+        if isinstance(fi, dict):
+            fi = FaultInjectionConfig(**fi)
+        self._inj: Optional[FaultInjector] = (
+            FaultInjector(fi) if fi.enabled else None)
 
         self.engine = engine
         self.cfg = engine.cfg
@@ -305,6 +362,20 @@ class ServingEngine:
         self._prefilling: dict[int, _Prefill] = {}  # slot -> admission state
         self._rr = 0  # round-robin cursor over prefilling slots
         self._results: dict[int, RequestResult] = {}
+        # quarantine bookkeeping: per-uid replay count, per-slot consecutive
+        # NaN-fault count, and slots pulled from rotation (suspect hardware)
+        self._requeues: dict[int, int] = {}
+        self._slot_faults = np.zeros((n,), np.int32)
+        self._quarantined_slots: set[int] = set()
+        self._poison = None  # jitted slot-KV NaN poke (fault injection only)
+        # uids that reached a terminal state since the last step() returned —
+        # step() drains this so callers driving the scheduler directly see
+        # EVERY completion (ok, expired, shed, deadline, cancelled, failed),
+        # not just EOS/length finishes
+        self._terminal_uids: list[int] = []
+        # deadline sweeping costs an O(queue + slots) host pass per decode
+        # step; skip it entirely until some live request can actually expire
+        self._deadlines_armed = self.default_deadline_s > 0
         self._epoch = time.perf_counter()
         self._decode = None  # jitted lazily (params pytree shapes needed)
         self._prefills: dict[int, object] = {}  # bucket len -> jitted prefill
@@ -338,11 +409,17 @@ class ServingEngine:
             # kernel streams one block for an idle row, not the whole cache
             logits, cache = tfm.apply_with_cache(
                 cfg, params, toks[:, None], cache, pos, write_pos=wpos)
+            # per-slot NaN sentinel: a non-finite logit row means the slot's
+            # state is poisoned (bad KV, numeric fault) — the host
+            # quarantines the request; the sampled token for such a row is
+            # garbage and discarded. Computed in the SAME program: the
+            # one-compiled-decode-step contract holds.
+            bad = jnp.any(~jnp.isfinite(logits[:, 0]), axis=-1)
             nxt = sample_logits_vector(logits[:, 0], rng, temp, top_k, top_p)
-            return cache, jnp.where(active, nxt, 0)
+            return cache, jnp.where(active, nxt, 0), bad
 
         return jax.jit(decode, donate_argnums=(1,),
-                       out_shardings=(self._cache_shardings, None))
+                       out_shardings=(self._cache_shardings, None, None))
 
     def _build_prefill(self, bucket: int):
         cfg = self.cfg
@@ -354,16 +431,17 @@ class ServingEngine:
             local = tfm.init_cache(cfg, 1, bucket, dtype=cache["k"].dtype)
             logits, local = tfm.apply_with_cache(
                 cfg, params, prompt, local, 0, last_index=true_len - 1)
+            bad = jnp.any(~jnp.isfinite(logits[:, 0]), axis=-1)
             tok = sample_logits_vector(logits[:, 0], rng, temp, top_k, top_p)
             cache = {
                 kv: jax.lax.dynamic_update_slice(
                     cache[kv], local[kv], (0, slot, 0, 0, 0))
                 for kv in ("k", "v")
             }
-            return cache, tok
+            return cache, tok, bad
 
         return jax.jit(prefill, donate_argnums=(1,),
-                       out_shardings=(self._cache_shardings, None))
+                       out_shardings=(self._cache_shardings, None, None))
 
     def _build_chunk(self, width: int):
         cfg = self.cfg
@@ -387,16 +465,19 @@ class ServingEngine:
             logits, local = tfm.apply_with_cache(
                 cfg, params, toks, local, jnp.reshape(start, (1,)),
                 last_index=true_len - 1)
+            # NaN mid-prompt propagates through attention to every later
+            # chunk, so the final chunk's sentinel covers the whole prefill
+            bad = jnp.any(~jnp.isfinite(logits[:, 0]), axis=-1)
             tok = sample_logits_vector(logits[:, 0], rng, temp, top_k, top_p)
             # write back ONLY the chunk's region [start, start+width) — the
             # rest of the window is unchanged, and splatting all Smax
             # positions per chunk would multiply the cache-write bandwidth
             # by Smax/width on exactly the prompt-side hot path
             new_kv = tfm.slice_cache_slot(local, 0, width, start=start)
-            return tfm.update_cache_slot(cache, new_kv, slot, start=start), tok
+            return tfm.update_cache_slot(cache, new_kv, slot, start=start), tok, bad
 
         return jax.jit(chunk, donate_argnums=(1,),
-                       out_shardings=(self._cache_shardings, None))
+                       out_shardings=(self._cache_shardings, None, None))
 
     def _build_fetch(self):
         pmax = self._pmax
@@ -421,6 +502,33 @@ class ServingEngine:
 
         return jax.jit(store, donate_argnums=(0,),
                        out_shardings=self._pool_shardings)
+
+    def _fill_slot(self, slot: int, value: float) -> None:
+        """Overwrite one slot's whole KV row with ``value`` — ONE compiled
+        program (slot and value are traced operands), cache sharding pinned
+        so the decode program's operand never drifts (no decode recompile).
+        Two callers: fault injection poisons with NaN so the next program
+        attending to the slot genuinely computes non-finite logits (the
+        device-side sentinel, not host bookkeeping, must catch it), and
+        quarantine scrubs with 0 before the slot re-enters rotation.
+
+        The scrub is load-bearing, not hygiene: attention computes scores
+        over ALL cache positions and zeros masked ones AFTER the fact, so a
+        NaN parked anywhere in the row leaks through ``0 * NaN = NaN`` into
+        every later occupant's logits even though the mask "hides" it —
+        NaN-faulted KV must never survive into a reused slot."""
+        if self._poison is None:
+            def fill(cache, slot, val):
+                return {
+                    kv: cache[kv].at[:, slot].set(val)
+                    for kv in ("k", "v")
+                }
+
+            self._poison = jax.jit(fill, donate_argnums=(0,),
+                                   out_shardings=self._cache_shardings)
+        self._cache = self._poison(
+            self._cache, jnp.int32(slot),
+            jnp.asarray(value, self._cache["k"].dtype))
 
     def _bucket_len(self, S: int) -> int:
         return min(_next_pow2(max(S, self.min_bucket)), self.Smax)
@@ -485,6 +593,27 @@ class ServingEngine:
         if request.uid in live:
             raise ValueError(f"request uid {request.uid} is already in flight "
                              "or finished; uids must be unique per engine")
+        if self.max_queue_len:
+            # load shedding: the bound covers requests that have ARRIVED but
+            # not been admitted (a future-dated request is scheduled, not
+            # queued — it is shed at step() time if the queue is still full
+            # when it arrives). Typed rejection instead of unbounded growth.
+            now = time.perf_counter() - self._epoch
+            if request.arrival_time <= now:
+                # same population as _shed_overflow: quarantine-requeued
+                # requests sit outside the bound accounting, so a transient
+                # fault never shrinks admission capacity
+                arrived = sum(1 for r in self._queue
+                              if r.arrival_time <= now
+                              and self._requeues.get(r.uid, 0) == 0)
+                if arrived >= self.max_queue_len:
+                    self.telemetry.counter("resilience/load_shed").inc()
+                    raise RequestRejected(
+                        request.uid, "queue_full",
+                        f"{arrived} arrived requests already queued "
+                        f"(max_queue_len={self.max_queue_len})")
+        if request.deadline_s > 0:
+            self._deadlines_armed = True
         self._queue.append(request)
         return request.uid
 
@@ -495,6 +624,14 @@ class ServingEngine:
     @property
     def n_prefilling(self) -> int:
         return len(self._prefilling)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def quarantined_slots(self) -> set[int]:
+        return set(self._quarantined_slots)
 
     def _pop_earliest_arrived(self, now: float) -> Optional[Request]:
         """Earliest-arrival request whose arrival_time has passed, removed
@@ -588,14 +725,15 @@ class ServingEngine:
                 wd.unique_name(f"serving/prefill[{bucket}]"), stable=True)
         self._rng, k = jax.random.split(self._rng)
         t_pre = time.perf_counter()
-        self._cache, tok = self._prefills[bucket](
+        self._cache, tok, bad = self._prefills[bucket](
             self.params, self._cache, jnp.asarray(padded),
             jnp.int32(slot), jnp.int32(S), k,
             jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_k], jnp.int32),
             jnp.asarray([req.top_p], jnp.float32),
         )
-        first = int(np.asarray(jax.device_get(tok))[0])
+        tok_h, bad_h = jax.device_get((tok, bad))
+        first = int(np.asarray(tok_h)[0])
         t_first = time.perf_counter() - self._epoch
         # the token fetch above synced, so this wall time is device-true;
         # the compiling call is excluded — compile/wall_s records it, and
@@ -603,7 +741,8 @@ class ServingEngine:
         if not self._prefills[bucket].last_call_compiled:
             tm.histogram("serving/prefill_sec").observe(time.perf_counter() - t_pre)
         tm.counter(f"serving/prefill_bucket[{bucket}]").inc()
-        self._activate(slot, req, prompt, first, t_adm, t_first, entry)
+        self._activate(slot, req, prompt, first, t_adm, t_first, entry,
+                       bad=bool(np.asarray(bad_h).reshape(-1)[0]))
 
     def _advance_prefill(self, slot: int):
         """Run ONE chunk of the slot's admission prefill; on the final chunk
@@ -616,7 +755,7 @@ class ServingEngine:
         tm = self.telemetry
         self._rng, k = jax.random.split(self._rng)
         t0 = time.perf_counter()
-        self._cache, tok = prog(
+        self._cache, tok, bad = prog(
             self.params, self._cache, jnp.asarray(toks),
             jnp.int32(slot), jnp.int32(start), jnp.int32(live), k,
             jnp.asarray([pf.req.temperature], jnp.float32),
@@ -628,21 +767,43 @@ class ServingEngine:
         if pf.idx < len(pf.segments):
             # intermediate chunk: the sampled token is garbage (mid-prompt
             # logits) and deliberately NOT fetched — the chunk stays an
-            # async dispatch the next decode step overlaps with
+            # async dispatch the next decode step overlaps with. A NaN here
+            # propagates through attention to the final chunk, whose fetched
+            # sentinel covers the whole prefill.
             return
-        first = int(np.asarray(jax.device_get(tok))[0])
+        tok_h, bad_h = jax.device_get((tok, bad))
+        first = int(np.asarray(tok_h)[0])
         t_first = time.perf_counter() - self._epoch
         # device-true (the fetch synced); the compiling call is excluded
         if not prog.last_call_compiled:
             tm.histogram("serving/chunk_prefill_sec").observe(time.perf_counter() - t0)
         del self._prefilling[slot]
-        self._activate(slot, pf.req, pf.prompt, first, pf.t_admit, t_first, pf.entry)
+        self._activate(slot, pf.req, pf.prompt, first, pf.t_admit, t_first,
+                       pf.entry, bad=bool(np.asarray(bad_h).reshape(-1)[0]))
 
     def _activate(self, slot: int, req: Request, prompt: np.ndarray,
-                  first: int, t_adm: float, t_first: float, entry):
+                  first: int, t_adm: float, t_first: float, entry,
+                  bad: bool = False):
         """Prompt KV fully resident in the slot + first token sampled:
         flip the slot to decoding and (policy permitting) cache the prompt's
-        prefix for future admissions."""
+        prefix for future admissions. A ``bad`` (non-finite logits) prefill
+        is quarantined instead: the slot is freed, the request requeued for
+        a clean replay, and — poison protection — the faulted KV is NEVER
+        offered to the prefix cache."""
+        if self._inj is not None and self._inj.garbage_logits(req.uid, "prefill"):
+            # make the fault REAL: the slot KV is NaN-poisoned, so an engine
+            # that ignored the sentinel would store poisoned prefix KV and
+            # decode garbage — the parity tests would catch it
+            self._fill_slot(slot, float("nan"))
+            self.telemetry.counter("resilience/injected_faults").inc()
+            bad = True
+        if bad:
+            self.telemetry.counter("resilience/nan_logit_faults").inc()
+            if entry is not None:
+                self._pfx.release(entry)  # the POOL entry is clean; our slot isn't
+            self._quarantine(slot, req, "prefill")
+            self._release_slot(slot)
+            return
         S = prompt.shape[0]
         st = self._slots[slot]
         st.uid = req.uid
@@ -650,6 +811,7 @@ class ServingEngine:
         st.eos = req.eos_token if req.eos_token is not None else -1
         st.tokens = [first]
         st.prefix_entry = entry
+        st.request = req
         st.result = RequestResult(
             uid=req.uid, tokens=np.zeros((0,), np.int32), prompt_len=S,
             arrival_time=req.arrival_time, admitted_time=t_adm,
@@ -692,28 +854,53 @@ class ServingEngine:
             tm.counter("serving/prefix_insert_skips").inc()
         tm.gauge("serving/prefix_pool_used").set(self._pfx.used_slots)
 
-    def _finish(self, slot: int):
+    def _finish(self, slot: int, status: str = "ok"):
         st = self._slots[slot]
         st.result.tokens = np.asarray(st.tokens, np.int32)
         st.result.finish_time = time.perf_counter() - self._epoch
+        st.result.status = status
+        st.result.requeues = self._requeues.get(st.uid, 0)
         self._results[st.uid] = st.result
+        self._terminal_uids.append(st.uid)
         res = st.result
-        if st.prefix_entry is not None:
-            self._pfx.release(st.prefix_entry)
         tm = self.telemetry
         tm.counter("serving/evictions").inc()
         tm.counter("serving/tokens_out").inc(len(res.tokens))
-        tm.histogram("serving/ttft_sec").observe(res.ttft)
-        tpot = res.time_per_output_token
-        if len(res.tokens) > 1:
-            tm.histogram("serving/tpot_sec").observe(tpot)
+        # every _finish caller is a NON-fault path (faults route through
+        # _quarantine), and the slot decoded with finite logits throughout —
+        # clear suspicion even for cancelled/deadline completions, else two
+        # UNRELATED faults weeks apart would read as "consecutive" and
+        # permanently quarantine a healthy slot
+        self._slot_faults[slot] = 0
+        if status == "ok":
+            if res.requeues:
+                # the quarantine path contained the fault and the replay
+                # finished cleanly
+                tm.counter("resilience/recovered").inc()
+            # latency stats cover completed requests only — a deadline
+            # eviction's truncated timings would pollute the percentiles
+            tm.histogram("serving/ttft_sec").observe(res.ttft)
+            tpot = res.time_per_output_token
+            if len(res.tokens) > 1:
+                tm.histogram("serving/tpot_sec").observe(tpot)
+        else:
+            tpot = 0.0
         tm.emit({
             "type": "request", "uid": res.uid, "slot": slot,
             "prompt_len": res.prompt_len, "n_tokens": int(len(res.tokens)),
-            "ttft_s": res.ttft, "tpot_s": tpot,
+            "ttft_s": res.ttft, "tpot_s": tpot, "status": status,
             "arrival_s": res.arrival_time, "finish_s": res.finish_time,
             "prefix_hit_tokens": res.prefix_hit_tokens,
         })
+        self._release_slot(slot)
+
+    def _release_slot(self, slot: int):
+        """Host-side slot teardown shared by every terminal path (finish,
+        deadline eviction, cancellation, quarantine). Purely per-slot array
+        resets — no device work, no new programs."""
+        st = self._slots[slot]
+        if st.prefix_entry is not None:
+            self._pfx.release(st.prefix_entry)
         self._slots[slot] = _Slot()
         self._active[slot] = False
         # pos 0 is the freed slot's ATTENTION position only (cheapest for the
@@ -724,18 +911,178 @@ class ServingEngine:
         self._temp[slot] = 0.0
         self._top_k[slot] = 0
         self._top_p[slot] = 1.0
-        self._free.append(slot)
+        if slot in self._quarantined_slots:
+            self.telemetry.gauge("resilience/quarantined_slots").set(
+                len(self._quarantined_slots))
+        else:
+            self._free.append(slot)
 
-    def step(self, now: float | None = None) -> list[int]:
-        """One scheduler iteration: admit arrived requests, advance at most
-        ``chunks_per_step`` admission chunks (round-robin over prefilling
-        slots — active slots never stall behind a long prompt), then advance
-        every active slot by one token (one device call). Returns the uids
-        finished during this step."""
+    def _synth_result(self, req: Request, status: str, slot: int = -1):
+        """Terminal result for a request that never produced tokens
+        (shed/expired/cancelled pre-activation/failed quarantine)."""
+        now = time.perf_counter() - self._epoch
+        res = RequestResult(
+            uid=req.uid, tokens=np.zeros((0,), np.int32),
+            prompt_len=int(np.asarray(req.prompt).shape[-1]),
+            arrival_time=req.arrival_time, finish_time=now, slot=slot,
+            status=status, requeues=self._requeues.get(req.uid, 0))
+        self._results[req.uid] = res
+        self._terminal_uids.append(req.uid)
+        self.telemetry.emit({
+            "type": "request", "uid": req.uid, "slot": slot,
+            "prompt_len": res.prompt_len, "n_tokens": 0, "status": status,
+            "arrival_s": req.arrival_time, "finish_s": now,
+        })
+        return res
+
+    # -- degradation paths (docs/resilience.md) -------------------------
+
+    def _deadline_of(self, req: Request) -> float:
+        d = req.deadline_s if req.deadline_s > 0 else self.default_deadline_s
+        return req.arrival_time + d if d > 0 else float("inf")
+
+    def cancel(self, uid: int) -> bool:
+        """Cancel a request wherever it is: queued (removed), mid-prefill
+        (slot freed, fetched prefix released), or mid-decode (evicted with
+        its partial output). Host-side state transitions only — in-flight
+        device work for the slot completes and is discarded (its KV writes
+        target a freed slot, which decode parks at the dropped position).
+        Returns False if the uid is unknown/already finished."""
+        tm = self.telemetry
+        for i, r in enumerate(self._queue):
+            if r.uid == uid:
+                del self._queue[i]
+                self._synth_result(r, "cancelled")
+                tm.counter("resilience/cancelled").inc()
+                return True
+        for slot, pf in list(self._prefilling.items()):
+            if pf.req.uid == uid:
+                if pf.entry is not None:
+                    self._pfx.release(pf.entry)
+                del self._prefilling[slot]
+                self._synth_result(pf.req, "cancelled", slot=slot)
+                # a mid-prefill slot's KV is UNVERIFIED (intermediate-chunk
+                # sentinels are never fetched) — scrub before reuse, else an
+                # undetected NaN leaks into the next occupant through masked
+                # attention (see _fill_slot)
+                self._fill_slot(slot, 0.0)
+                self._release_slot(slot)
+                tm.counter("resilience/cancelled").inc()
+                return True
+        for slot in range(self.n_slots):
+            if self._active[slot] and self._slots[slot].uid == uid:
+                self._finish(slot, status="cancelled")
+                tm.counter("resilience/cancelled").inc()
+                return True
+        return False
+
+    def _sweep_deadlines(self, now: float):
+        """Shed queued requests past their deadline; cancel prefilling and
+        evict decoding slots past theirs (partial output returned)."""
+        tm = self.telemetry
+        expired = [r for r in self._queue if now > self._deadline_of(r)]
+        for r in expired:
+            self._queue.remove(r)
+            self._synth_result(r, "expired")
+            tm.counter("resilience/deadline_shed").inc()
+        for slot, pf in list(self._prefilling.items()):
+            if now > self._deadline_of(pf.req):
+                if pf.entry is not None:
+                    self._pfx.release(pf.entry)
+                del self._prefilling[slot]
+                self._synth_result(pf.req, "deadline_exceeded", slot=slot)
+                # mid-prefill KV is unverified — scrub before reuse (see
+                # the same path in cancel())
+                self._fill_slot(slot, 0.0)
+                self._release_slot(slot)
+                tm.counter("resilience/deadline_evictions").inc()
+        for slot in range(self.n_slots):
+            st = self._slots[slot]
+            if (self._active[slot] and st.request is not None
+                    and now > self._deadline_of(st.request)):
+                self._finish(slot, status="deadline_exceeded")
+                tm.counter("resilience/deadline_evictions").inc()
+
+    def _shed_overflow(self, now: float):
+        """Bounded arrival queue: if more requests have ARRIVED than
+        ``max_queue_len``, shed the newest arrivals (admission order is
+        earliest-first, so the head of the backlog keeps its place).
+        Quarantine-requeued requests sit OUTSIDE the bound accounting — they
+        were already admitted once and granted a clean replay, so they are
+        neither shed nor allowed to push an already-accepted arrival over
+        the bound; the backlog may transiently overshoot by at most the
+        number of in-flight faults (<= n_slots)."""
+        if not self.max_queue_len:
+            return
+        arrived = [r for r in self._queue
+                   if r.arrival_time <= now
+                   and self._requeues.get(r.uid, 0) == 0]
+        excess = len(arrived) - self.max_queue_len
+        if excess <= 0:
+            return
+        arrived.sort(key=lambda r: r.arrival_time)
+        for r in arrived[-excess:]:
+            self._queue.remove(r)
+            self._synth_result(r, "shed_queue_full")
+            self.telemetry.counter("resilience/load_shed").inc()
+
+    def _quarantine(self, slot: int, req: Request, phase: str):
+        """Non-finite logits for ``req`` in ``slot``: contain (free the slot,
+        never keep its KV), then requeue the request once for a clean replay
+        — a second fault fails it. Repeated faults on one slot pull the slot
+        out of rotation (suspect lane), never the last healthy one."""
+        tm = self.telemetry
+        tm.counter("resilience/quarantines").inc()
+        # scrub before the slot can be reused: NaN KV anywhere in the row
+        # poisons later occupants through masked attention (see _fill_slot)
+        self._fill_slot(slot, 0.0)
+        self._slot_faults[slot] += 1
+        healthy = self.n_slots - len(self._quarantined_slots)
+        if (self._slot_faults[slot] >= self.slot_quarantine_after
+                and healthy > 1 and slot not in self._quarantined_slots):
+            self._quarantined_slots.add(slot)
+            tm.counter("resilience/slots_quarantined").inc()
+            log_dist(
+                f"serving: slot {slot} quarantined after "
+                f"{int(self._slot_faults[slot])} consecutive NaN faults",
+                ranks=[0])
+        n = self._requeues.get(req.uid, 0)
+        if n < self.quarantine_max_requeues:
+            self._requeues[req.uid] = n + 1
+            tm.counter("resilience/requeues").inc()
+            log_dist(
+                f"serving: request {req.uid} hit non-finite logits in slot "
+                f"{slot} ({phase}); requeued for clean replay "
+                f"({n + 1}/{self.quarantine_max_requeues})", ranks=[0])
+            self._queue.append(req)
+        else:
+            tm.counter("resilience/failed_requests").inc()
+            self._synth_result(req, "failed_nan", slot=slot)
+
+    def step(self, now: float | None = None, *,
+             enforce_deadlines: bool = True) -> list[int]:
+        """One scheduler iteration: sweep deadlines and shed queue overflow,
+        admit arrived requests, advance at most ``chunks_per_step`` admission
+        chunks (round-robin over prefilling slots — active slots never stall
+        behind a long prompt), then advance every active slot by one token
+        (one device call). Returns the uids that reached a TERMINAL state
+        since the last step() returned — finished ok, expired, shed,
+        deadline-evicted, cancelled, or failed — so a caller driving the
+        scheduler directly never waits forever on a degraded request.
+        ``enforce_deadlines=False`` (drain mode) skips the deadline sweep —
+        drain's ``now=inf`` would otherwise expire everything."""
         if now is None:
             now = time.perf_counter() - self._epoch
-        self._admit(now)
         tm = self.telemetry
+        if enforce_deadlines:
+            if self._deadlines_armed:
+                self._sweep_deadlines(now)
+            # drain-mode (now=inf) exemption applies here too: it would
+            # treat every future-dated request as simultaneously arrived
+            # and shed a backlog that real-time stepping would have
+            # admitted one slot at a time
+            self._shed_overflow(now)
+        self._admit(now)
         tm.gauge("serving/queue_depth").set(len(self._queue))
         tm.gauge("serving/prefilling_slots").set(len(self._prefilling))
         for _ in range(self.chunk_cfg.chunks_per_step):
@@ -745,7 +1092,12 @@ class ServingEngine:
             self._advance_prefill(slots[self._rr % len(slots)])
             self._rr += 1
         if not self._active.any():
-            return []
+            # the occupancy gauge must read 0 once the engine idles — the
+            # bench's slot-leak check watches exactly this
+            tm.gauge("serving/active_slots").set(0)
+            finished = self._terminal_uids
+            self._terminal_uids = []
+            return finished
         if self._decode is None:
             # THE compile-stable path: a second compilation here means an
             # operand's shape/dtype/sharding drifted and every admission
@@ -758,6 +1110,16 @@ class ServingEngine:
         tm.gauge("serving/active_slots").set(n_active)
         tm.histogram("serving/queue_depth_hist").observe(len(self._queue))
         tm.histogram("serving/slot_occupancy").observe(n_active / self.n_slots)
+        if self._inj is not None:
+            # decode-phase fault injection: NaN-poison the chosen request's
+            # slot KV BEFORE the decode dispatch, so THIS decode genuinely
+            # computes non-finite logits and the device sentinel must fire
+            for slot in range(self.n_slots):
+                st = self._slots[slot]
+                if self._active[slot] and self._inj.garbage_logits(
+                        st.uid, "decode", len(st.tokens) - 1):
+                    self._fill_slot(slot, float("nan"))
+                    tm.counter("resilience/injected_faults").inc()
         self._rng, k = jax.random.split(self._rng)
         # inactive slots WRITE at position Smax — the cache scatter's
         # mode="drop" discards their garbage KV entirely. Writing at 0 (the
@@ -768,7 +1130,7 @@ class ServingEngine:
         # length-aware decode kernel never streams the full cache for them.
         wpos = np.where(self._active, self._pos, np.int32(self.Smax))
         t_dec = time.perf_counter()
-        self._cache, nxt = self._decode(
+        self._cache, nxt, bad = self._decode(
             self.params, self._cache, jnp.asarray(self._last_tok),
             jnp.asarray(self._pos), jnp.asarray(wpos, np.int32),
             jnp.asarray(self._active), k,
@@ -776,34 +1138,48 @@ class ServingEngine:
             jnp.asarray(self._top_p),
         )
         self._decode_steps += 1
-        nxt = np.asarray(jax.device_get(nxt))
+        nxt, bad = (np.asarray(x) for x in jax.device_get((nxt, bad)))
         # nxt is fetched: the decode program has fully executed on device.
         # The compiling call is excluded from the latency histogram (it is
         # compile/wall_s's datum, and would otherwise be the p99)
         if not self._decode.last_call_compiled:
             tm.histogram("serving/decode_step_sec").observe(time.perf_counter() - t_dec)
         tm.counter("serving/decode_steps").inc()
-        finished = []
         for slot in range(self.n_slots):
             if not self._active[slot]:
                 continue
             st = self._slots[slot]
+            if bad[slot]:
+                # non-finite logits: the slot's KV/state is poisoned. The
+                # sampled token is garbage — discard the request's partial
+                # output, free the slot (host-side transition only) and
+                # requeue for a clean replay. The batch keeps decoding.
+                tm.counter("resilience/nan_logit_faults").inc()
+                req = st.request
+                self._quarantine(slot, req, "decode")
+                self._release_slot(slot)
+                continue
             tok = int(nxt[slot])
             st.tokens.append(tok)
             st.remaining -= 1
             self._pos[slot] += 1
             self._last_tok[slot] = tok
             if tok == st.eos or st.remaining <= 0:
-                uid = st.uid
-                self._finish(slot)
-                finished.append(uid)
+                self._finish(slot)  # records the uid in _terminal_uids
+        if not self._active.any():
+            tm.gauge("serving/active_slots").set(0)
+        finished = self._terminal_uids
+        self._terminal_uids = []
         return finished
 
     def drain(self) -> dict[int, RequestResult]:
         """Run steps until queue and slots are empty (ignoring arrival
-        times); return all results so far."""
+        times, deadlines AND the queue bound — drain's ``now=inf`` clock
+        would otherwise expire every deadline-bearing request and shed
+        every future-dated one as a simultaneous arrival); return all
+        results so far."""
         while self._queue or self._prefilling or self._active.any():
-            self.step(now=float("inf"))
+            self.step(now=float("inf"), enforce_deadlines=False)
         return dict(self._results)
 
     def serve(self, requests: list[Request]) -> dict[int, RequestResult]:
@@ -813,12 +1189,18 @@ class ServingEngine:
         flight if it outlives this call). Returns {uid: RequestResult} for
         this call's requests, timed against the engine epoch — which is
         reset only when the engine is idle, so in-flight requests' timings
-        stay coherent."""
+        stay coherent. A request load-shed at submit time still gets a
+        result (status ``shed_queue_full``) rather than an exception — the
+        typed ``RequestRejected`` is for direct ``submit()`` callers."""
         if not self._queue and not self._prefilling and not self._active.any():
             self._epoch = time.perf_counter()
         target = set()
         for r in sorted(requests, key=lambda r: r.arrival_time):
-            target.add(self.submit(r))
+            try:
+                target.add(self.submit(r))
+            except RequestRejected as e:
+                self._synth_result(r, "shed_" + e.reason)
+                target.add(r.uid)
         while not target <= set(self._results):
             now = time.perf_counter() - self._epoch
             if (not self._active.any() and not self._prefilling
@@ -867,6 +1249,8 @@ class ServingEngine:
         extra = {}
         if self._pfx is not None:
             extra["prefix_cache"] = self._pfx.stats()
+        if self._inj is not None:
+            extra["fault_injection"] = self._inj.stats()
         snap = self.telemetry.snapshot(
             compiles=self.compile_counts(),
             comm=comms_logger.summary(),

@@ -1,0 +1,51 @@
+"""Resilience subsystem: fault injection + fault tolerance.
+
+Three pillars (docs/resilience.md):
+
+  * ``faults``      — seeded deterministic FaultInjector (NaN gradients,
+                      checkpoint I/O errors, garbage serving logits,
+                      simulated preemption) so every recovery path has a
+                      test that passes only because recovery works;
+  * ``guardrails``  — training-side NaN/overflow streak tracking with
+                      skip → rewind → diverged escalation;
+  * typed errors    — ``errors`` module; checkpoint integrity errors,
+                      preemption, serving load-shed rejections.
+
+Serving-side degradation (deadlines, load shedding, quarantine) lives in
+``inference/serving.py`` and reports through the same ``resilience/*``
+telemetry namespace.
+"""
+
+from .errors import (
+    CheckpointCorruptError,
+    CheckpointError,
+    CheckpointNotFoundError,
+    PreemptionSignal,
+    RequestRejected,
+    ResilienceError,
+    TrainingDivergedError,
+)
+from .faults import (
+    FaultInjector,
+    clear_injector,
+    get_injector,
+    install_injector,
+    maybe_io_error,
+)
+from .guardrails import TrainingGuardrail
+
+__all__ = [
+    "CheckpointCorruptError",
+    "CheckpointError",
+    "CheckpointNotFoundError",
+    "FaultInjector",
+    "PreemptionSignal",
+    "RequestRejected",
+    "ResilienceError",
+    "TrainingDivergedError",
+    "TrainingGuardrail",
+    "clear_injector",
+    "get_injector",
+    "install_injector",
+    "maybe_io_error",
+]

@@ -1,0 +1,70 @@
+"""Typed resilience errors — the exception vocabulary of the fault-tolerance
+layer (docs/resilience.md).
+
+Every failure the subsystem detects or injects surfaces as one of these
+instead of an opaque low-level error, so callers (training loops, serving
+drivers, CI harnesses) can branch on the failure *kind*:
+
+  * checkpoint errors carry the offending path — a torn checkpoint is
+    distinguishable from a missing one (load falls back only for the former);
+  * ``PreemptionSignal`` is the simulated/real "save and exit" signal;
+  * ``RequestRejected`` is the serving load-shed verdict with a typed reason.
+
+Stdlib-only on purpose: ``checkpoint/saver.py`` (imported in offline tooling
+contexts) and the report CLI must be able to import these without jax.
+"""
+
+from __future__ import annotations
+
+
+class ResilienceError(Exception):
+    """Base class for every typed failure the resilience layer raises."""
+
+
+class CheckpointError(ResilienceError):
+    def __init__(self, message: str, path: str = ""):
+        super().__init__(message)
+        self.path = path
+
+
+class CheckpointNotFoundError(CheckpointError):
+    """No checkpoint at the requested path (missing directory, manifest, or
+    'latest' tag) — nothing was ever durable there; there is nothing to fall
+    back to and loading code should treat this as a cold start."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A checkpoint exists but fails integrity verification (torn write,
+    digest mismatch, missing shard file). The *directory* is suspect, not
+    the tag namespace — load falls back to the newest intact sibling."""
+
+
+class TrainingDivergedError(ResilienceError):
+    """The NaN/overflow streak exceeded ``max_consecutive_bad_steps`` and no
+    rewind target exists (rewind disabled, or no checkpoint was ever saved).
+    Raised instead of burning compute on a poisoned trajectory."""
+
+
+class PreemptionSignal(ResilienceError):
+    """Preemption requested (injected by the fault injector, or wired to a
+    real SIGTERM handler). Raised *before* a step is dispatched, so
+    ``engine.state`` is the consistent post-previous-step state and can be
+    checkpointed immediately."""
+
+    def __init__(self, step: int):
+        super().__init__(f"preemption signalled before step {step + 1}")
+        self.step = step
+
+
+class RequestRejected(ResilienceError):
+    """Serving load-shed verdict: the request was refused admission instead
+    of growing the arrival queue without bound. ``reason`` is a stable typed
+    string — currently always ``queue_full`` (a deadline that expires while
+    QUEUED surfaces as a result with status ``expired``, not an
+    exception)."""
+
+    def __init__(self, uid: int, reason: str, detail: str = ""):
+        super().__init__(
+            f"request {uid} rejected ({reason})" + (f": {detail}" if detail else ""))
+        self.uid = uid
+        self.reason = reason

@@ -1,0 +1,181 @@
+"""Deterministic, config-selected fault injection.
+
+The recovery paths in this codebase (NaN skip/rewind, torn-checkpoint
+fallback, serving quarantine/load-shed) are only trustworthy if each has a
+test that *fails when recovery is broken*. This module is the failure
+source: a seeded injector whose every decision is a pure function of
+``(seed, site, key)`` — two runs with the same config inject the same
+faults at the same sites, so recovery tests are reproducible and a
+greedy-parity comparison against an unfaulted run is meaningful.
+
+Fault sites (see docs/resilience.md for where each is wired):
+
+  ``nan_grads``       non-finite loss/gradients at a chosen training step
+                      (runtime/engine.py poisons the loss scale transiently,
+                      producing genuinely non-finite values *inside* the
+                      compiled step — the program itself is unchanged).
+  ``io_error``        ``OSError`` on the Nth guarded checkpoint/swap write
+                      (checkpoint/saver.py consults the installed injector
+                      before each file write).
+  ``garbage_logits``  NaN logits for a chosen request: the serving engine
+                      poisons the request's slot KV so the next compiled
+                      decode/prefill genuinely computes non-finite logits
+                      (the device-side sentinel must catch it).
+  ``preempt``         simulated preemption before a chosen training step
+                      (``PreemptionSignal`` raised pre-dispatch).
+
+Two selection modes compose:
+
+  * **deterministic lists** (``nan_grad_steps``, ``io_error_writes``,
+    ``garbage_logits_uids`` + phase/step, ``preempt_steps``) fire exactly
+    once per listed key — a rewound/replayed step or a requeued request is
+    NOT re-faulted, modelling a transient fault rather than a permanent one;
+  * **rate mode** (``rate`` in (0, 1], optionally restricted by ``sites``)
+    draws per opportunity from a crc32 hash of ``(seed, site, #opportunity)``
+    — deterministic across runs, independent across opportunities.
+
+Stdlib-only (no jax/numpy): importable from ``checkpoint/saver.py`` and the
+report CLI without pulling in a device runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from collections import Counter
+from typing import Any, Optional
+
+
+def _get(cfg: Any, name: str, default):
+    if isinstance(cfg, dict):
+        return cfg.get(name, default)
+    return getattr(cfg, name, default)
+
+
+class FaultInjector:
+    """Seeded deterministic fault source. ``cfg`` is a
+    ``runtime.config.FaultInjectionConfig``, a plain dict with the same
+    keys, or None (disabled)."""
+
+    SITES = ("nan_grads", "io_error", "garbage_logits", "preempt")
+
+    def __init__(self, cfg: Any = None):
+        self.enabled = bool(_get(cfg, "enabled", False)) if cfg is not None else False
+        self.seed = int(_get(cfg, "seed", 0))
+        self.rate = float(_get(cfg, "rate", 0.0))
+        self.sites = set(_get(cfg, "sites", []) or [])
+        self.nan_grad_steps = set(_get(cfg, "nan_grad_steps", []) or [])
+        self.io_error_writes = set(_get(cfg, "io_error_writes", []) or [])
+        self.garbage_logits_uids = set(_get(cfg, "garbage_logits_uids", []) or [])
+        self.garbage_logits_phase = str(_get(cfg, "garbage_logits_phase", "decode"))
+        self.garbage_logits_decode_step = int(_get(cfg, "garbage_logits_decode_step", 0))
+        self.preempt_steps = set(_get(cfg, "preempt_steps", []) or [])
+        self._writes = 0  # guarded-write clock (io_error site)
+        self._fired: set = set()  # list-mode keys fire exactly once
+        self._lock = threading.Lock()
+        self.injected: Counter = Counter()
+        self.opportunities: Counter = Counter()
+
+    # -- core decisions -------------------------------------------------
+
+    def _rate_fire(self, site: str) -> bool:
+        if self.rate <= 0.0 or (self.sites and site not in self.sites):
+            return False
+        # one independent deterministic draw per opportunity: the hash is
+        # keyed by the per-site opportunity counter, so a replayed request /
+        # rewound step gets a FRESH draw (its counter has advanced)
+        n = self.opportunities[site]
+        h = zlib.crc32(f"{self.seed}:{site}:{n}".encode()) & 0xFFFFFFFF
+        return h / float(0x100000000) < self.rate
+
+    def _fire(self, site: str, listed: bool, key) -> bool:
+        """One fault decision. List-mode keys fire once, ever."""
+        with self._lock:
+            self.opportunities[site] += 1
+            hit = False
+            if listed:
+                k = (site, key)
+                if k not in self._fired:
+                    self._fired.add(k)
+                    hit = True
+            if not hit:
+                hit = self._rate_fire(site)
+            if hit:
+                self.injected[site] += 1
+            return hit
+
+    # -- typed sites ----------------------------------------------------
+
+    def nan_grads(self, step: int) -> bool:
+        """True if the training step about to run (1-based global step)
+        should see non-finite gradients."""
+        if not self.enabled:
+            return False
+        return self._fire("nan_grads", step in self.nan_grad_steps, step)
+
+    def io_error(self, path: str) -> None:
+        """Guarded-write hook: advances the write clock and raises ``OSError``
+        when this write is armed (listed index is 1-based)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._writes += 1
+            n = self._writes
+        if self._fire("io_error", n in self.io_error_writes, n):
+            raise OSError(
+                f"fault injection: io_error on guarded write #{n} ({path})")
+
+    def garbage_logits(self, uid: int, phase: str, decode_step: int = 0) -> bool:
+        """True if request ``uid`` should produce NaN logits now. ``phase``
+        is ``prefill`` (at admission completion) or ``decode`` with the
+        request's 0-based decode-step index."""
+        if not self.enabled:
+            return False
+        listed = (
+            uid in self.garbage_logits_uids
+            and phase == self.garbage_logits_phase
+            and (phase == "prefill" or decode_step == self.garbage_logits_decode_step)
+        )
+        return self._fire("garbage_logits", listed, (uid, phase, decode_step))
+
+    def preempt(self, step: int) -> bool:
+        """True if a preemption signal should fire before running ``step``
+        (1-based global step)."""
+        if not self.enabled:
+            return False
+        return self._fire("preempt", step in self.preempt_steps, step)
+
+    def stats(self) -> dict:
+        return {
+            "injected": dict(self.injected),
+            "opportunities": dict(self.opportunities),
+            "guarded_writes": self._writes,
+        }
+
+
+# -- process-global injector -------------------------------------------
+# checkpoint/saver.py's free functions have no engine handle to thread an
+# injector through; they consult this slot instead. The engine installs its
+# injector at init; tests install/clear around save/load calls.
+
+_installed: Optional[FaultInjector] = None
+
+
+def install_injector(inj: Optional[FaultInjector]) -> None:
+    global _installed
+    _installed = inj
+
+
+def clear_injector() -> None:
+    install_injector(None)
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _installed
+
+
+def maybe_io_error(path: str) -> None:
+    """Guarded-write hook for code without an injector reference (no-op
+    unless an enabled injector is installed)."""
+    if _installed is not None:
+        _installed.io_error(path)

@@ -23,7 +23,7 @@ class CheckpointEngine:
              latest: Optional[tuple] = None):
         raise NotImplementedError
 
-    def load(self, ckpt_dir: str, state_like, shardings):
+    def load(self, ckpt_dir: str, state_like, shardings, verify: bool = True):
         raise NotImplementedError
 
     def commit(self):
@@ -48,10 +48,10 @@ class NativeCheckpointEngine(CheckpointEngine):
             self.commit()
         return self._pending
 
-    def load(self, ckpt_dir: str, state_like, shardings):
+    def load(self, ckpt_dir: str, state_like, shardings, verify: bool = True):
         from ...checkpoint.saver import load_checkpoint
 
-        return load_checkpoint(ckpt_dir, state_like, shardings)
+        return load_checkpoint(ckpt_dir, state_like, shardings, verify=verify)
 
     def commit(self):
         if self._pending is not None:
@@ -87,12 +87,14 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             with open(os.path.join(ckpt_dir, "client_state.json"), "w") as f:
                 json.dump(client_state or {}, f)
             if latest is not None:
-                lpath, tag = latest
-                with open(lpath, "w") as f:
-                    f.write(tag)
+                from ...checkpoint.saver import write_latest
+
+                write_latest(*latest)
         return None
 
-    def load(self, ckpt_dir: str, state_like, shardings):
+    def load(self, ckpt_dir: str, state_like, shardings, verify: bool = True):
+        # orbax owns its own integrity story; ``verify`` applies to the
+        # native manifest digests only
         import json
 
         import jax

@@ -228,6 +228,90 @@ class TelemetryConfig:
 
 
 @dataclass
+class FaultInjectionConfig:
+    """Deterministic fault-injection block (``resilience.fault_injection``
+    for training/checkpointing, ``serving.fault_injection`` for the serving
+    engine; consumed by ``resilience/faults.FaultInjector``;
+    docs/resilience.md).
+
+    Two selection modes compose: the deterministic lists fire exactly once
+    per listed key (a rewound step / requeued request is not re-faulted —
+    transient-fault model), and ``rate`` adds an independent seeded draw per
+    opportunity (for randomized smoke runs, e.g. ``bench.py --fault-rate``).
+
+    - ``nan_grad_steps``: 1-based global steps whose gradients go non-finite.
+    - ``io_error_writes``: 1-based indices of guarded checkpoint file writes
+      that raise ``OSError``.
+    - ``garbage_logits_uids`` (+ ``garbage_logits_phase`` ``prefill|decode``,
+      ``garbage_logits_decode_step`` 0-based): serving requests whose slot KV
+      is poisoned so the compiled program genuinely computes NaN logits.
+    - ``preempt_steps``: 1-based global steps before which a
+      ``PreemptionSignal`` is raised (pre-dispatch: state is checkpointable).
+    - ``rate`` in [0, 1] with optional ``sites`` allowlist
+      (``nan_grads`` | ``io_error`` | ``garbage_logits`` | ``preempt``).
+    """
+
+    enabled: bool = False
+    seed: int = 0
+    rate: float = 0.0
+    sites: list = field(default_factory=list)
+    nan_grad_steps: list = field(default_factory=list)
+    io_error_writes: list = field(default_factory=list)
+    garbage_logits_uids: list = field(default_factory=list)
+    garbage_logits_phase: str = "decode"
+    garbage_logits_decode_step: int = 0
+    preempt_steps: list = field(default_factory=list)
+
+    def __post_init__(self):
+        if not 0.0 <= self.rate <= 1.0:
+            raise DeepSpeedConfigError(
+                f"fault_injection.rate must be in [0, 1], got {self.rate}")
+        if self.garbage_logits_phase not in ("prefill", "decode"):
+            raise DeepSpeedConfigError(
+                "fault_injection.garbage_logits_phase must be prefill|decode, "
+                f"got {self.garbage_logits_phase!r}")
+        bad = set(self.sites) - {"nan_grads", "io_error", "garbage_logits", "preempt"}
+        if bad:
+            raise DeepSpeedConfigError(
+                f"fault_injection.sites contains unknown site(s) {sorted(bad)}")
+
+
+@dataclass
+class ResilienceConfig:
+    """Training resilience block (``resilience``; consumed by
+    ``runtime/engine.py`` + ``resilience/guardrails.py``; docs/resilience.md).
+
+    - ``enabled``: arm the host-side guardrail. The compiled step *always*
+      skips non-finite updates (the loss-scale overflow path gates bf16/fp32
+      too); this switch adds per-step host tracking of the overflow scalar —
+      one scalar device fetch per step, which breaks the async step chain,
+      so it is off by default and meant for production training jobs where
+      a wedged run costs more than the sync.
+    - ``max_consecutive_bad_steps``: streak length at which skipping is
+      declared insufficient and the engine rewinds (or raises
+      ``TrainingDivergedError`` when no rewind target exists).
+    - ``rewind``: reload the last checkpoint saved outside a bad streak when
+      the streak threshold is hit. Data-loader replay after a rewind is the
+      caller's responsibility (the engine restores model/optimizer state and
+      the step clock).
+    - ``fault_injection``: deterministic fault source for tests/CI smoke.
+    """
+
+    enabled: bool = False
+    max_consecutive_bad_steps: int = 3
+    rewind: bool = True
+    fault_injection: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
+
+    def __post_init__(self):
+        if isinstance(self.fault_injection, dict):
+            self.fault_injection = _build(FaultInjectionConfig, self.fault_injection)
+        if self.max_consecutive_bad_steps < 1:
+            raise DeepSpeedConfigError(
+                "resilience.max_consecutive_bad_steps must be >= 1, got "
+                f"{self.max_consecutive_bad_steps}")
+
+
+@dataclass
 class PrefixCacheConfig:
     """Serving prefix-cache block (``serving.prefix_cache``; docs/serving.md).
 
@@ -309,7 +393,25 @@ class ChunkedPrefillConfig:
 @dataclass
 class ServingConfig:
     """Serving-engine block (``serving``; consumed by
-    ``deepspeed_tpu.inference.ServingEngine``, docs/serving.md)."""
+    ``deepspeed_tpu.inference.ServingEngine``, docs/serving.md).
+
+    Degradation knobs (docs/resilience.md):
+
+    - ``max_queue_len``: bound on *arrived* not-yet-admitted requests; when
+      exceeded the newest arrivals are load-shed with a typed
+      ``RequestRejected(reason="queue_full")`` / ``shed_queue_full`` result
+      instead of growing the queue without bound. 0 = unbounded.
+    - ``default_deadline_s``: deadline (seconds after arrival) applied to
+      requests that do not carry their own; past it a queued request is shed
+      (``expired``) and an in-flight one is cancelled mid-prefill or evicted
+      mid-decode with its partial output (``deadline_exceeded``). 0 = none.
+    - ``quarantine_max_requeues``: times a request whose logits went
+      non-finite is re-queued for a clean replay before being failed
+      (``failed_nan``).
+    - ``slot_quarantine_after``: consecutive NaN-logit faults in one slot
+      after which that slot is pulled from rotation (suspected bad hardware
+      lane); the last healthy slot is never quarantined.
+    """
 
     n_slots: int = 8
     max_seq_len: int = 0  # 0 = the engine's sequence budget
@@ -317,18 +419,40 @@ class ServingConfig:
     seed: int = 0
     jsonl_path: str = ""
     watchdog_mode: str = "warn"
+    max_queue_len: int = 0  # 0 = unbounded
+    default_deadline_s: float = 0.0  # 0 = no deadline
+    quarantine_max_requeues: int = 1
+    slot_quarantine_after: int = 2
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     chunked_prefill: ChunkedPrefillConfig = field(default_factory=ChunkedPrefillConfig)
+    fault_injection: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
 
     def __post_init__(self):
         if isinstance(self.prefix_cache, dict):
             self.prefix_cache = _build(PrefixCacheConfig, self.prefix_cache)
         if isinstance(self.chunked_prefill, dict):
             self.chunked_prefill = _build(ChunkedPrefillConfig, self.chunked_prefill)
+        if isinstance(self.fault_injection, dict):
+            self.fault_injection = _build(FaultInjectionConfig, self.fault_injection)
         if self.watchdog_mode not in ("off", "warn", "raise"):
             raise DeepSpeedConfigError(
                 f"serving.watchdog_mode must be off|warn|raise, "
                 f"got {self.watchdog_mode!r}")
+        if self.max_queue_len < 0:
+            raise DeepSpeedConfigError(
+                f"serving.max_queue_len must be >= 0, got {self.max_queue_len}")
+        if self.default_deadline_s < 0:
+            raise DeepSpeedConfigError(
+                f"serving.default_deadline_s must be >= 0, "
+                f"got {self.default_deadline_s}")
+        if self.quarantine_max_requeues < 0:
+            raise DeepSpeedConfigError(
+                f"serving.quarantine_max_requeues must be >= 0, "
+                f"got {self.quarantine_max_requeues}")
+        if self.slot_quarantine_after < 1:
+            raise DeepSpeedConfigError(
+                f"serving.slot_quarantine_after must be >= 1, "
+                f"got {self.slot_quarantine_after}")
 
 
 @dataclass
@@ -405,10 +529,27 @@ class MeshAxesConfig:
 
 @dataclass
 class CheckpointConfig:
+    """``checkpoint`` block. ``keep_last_k > 0`` prunes older tags after
+    each save (the 'latest'-pointed tag, the newest save, and the
+    guardrail's last-good rewind target are always kept); 0 keeps all.
+    ``verify_integrity=False`` skips the digest pass on load (it reads
+    every checkpoint byte before the mmap'd restore — worth skipping for
+    huge checkpoints on trusted storage); torn-checkpoint *detection* and
+    fallback then rest on manifest presence alone."""
+
     tag_validation: str = "Warn"  # Ignore | Warn | Fail
     load_universal: bool = False
     use_node_local_storage: bool = False
     parallel_write_pipeline: bool = False
+    engine: Optional[str] = None  # native | orbax (None = native)
+    async_save: bool = False
+    keep_last_k: int = 0  # 0 = keep every checkpoint
+    verify_integrity: bool = True  # digest-check files before load
+
+    def __post_init__(self):
+        if self.keep_last_k < 0:
+            raise DeepSpeedConfigError(
+                f"checkpoint.keep_last_k must be >= 0, got {self.keep_last_k}")
 
 
 @dataclass
@@ -460,6 +601,7 @@ class DeepSpeedConfig:
     csv_monitor: MonitorBackendConfig = field(default_factory=MonitorBackendConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     curriculum_learning: CurriculumConfig = field(default_factory=CurriculumConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = field(default_factory=ProgressiveLayerDropConfig)
     eigenvalue: EigenvalueConfig = field(default_factory=EigenvalueConfig)
@@ -506,6 +648,7 @@ class DeepSpeedConfig:
             csv_monitor=_build(MonitorBackendConfig, _sub(d, C.MONITOR_CSV)),
             telemetry=_build(TelemetryConfig, _sub(d, C.TELEMETRY)),
             serving=_build(ServingConfig, _sub(d, C.SERVING)),
+            resilience=_build(ResilienceConfig, _sub(d, C.RESILIENCE)),
             curriculum_learning=_build(CurriculumConfig, _sub(d, C.CURRICULUM_LEARNING)),
             progressive_layer_drop=_build(ProgressiveLayerDropConfig, _sub(d, C.PROGRESSIVE_LAYER_DROP)),
             eigenvalue=_build(EigenvalueConfig, _sub(d, "eigenvalue")),

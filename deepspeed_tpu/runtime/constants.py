@@ -36,6 +36,7 @@ MONITOR_WANDB = "wandb"
 MONITOR_CSV = "csv_monitor"
 TELEMETRY = "telemetry"
 SERVING = "serving"
+RESILIENCE = "resilience"
 CURRICULUM_LEARNING = "curriculum_learning"
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"
 ELASTICITY = "elasticity"

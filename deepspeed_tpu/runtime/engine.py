@@ -168,6 +168,37 @@ class DeepSpeedEngine:
         )
         self._last_seen_loss_scale = None  # boundary-sampled flip detection
 
+        # ---- resilience (resilience/; docs/resilience.md) -------------------
+        # The compiled step always skips non-finite updates (fp16 overflow
+        # path, gated on ``finite`` for bf16/fp32 too); the guardrail adds
+        # host-side streak tracking + rewind, at the cost of one overflow
+        # scalar fetch per step (breaks the async step chain — opt-in).
+        from ..resilience import FaultInjector, TrainingGuardrail, install_injector
+
+        rcfg = self.config.resilience
+        self.fault_injector = None
+        if rcfg.fault_injection.enabled:
+            self.fault_injector = FaultInjector(rcfg.fault_injection)
+            log_dist(
+                f"resilience: fault injection armed "
+                f"(seed {rcfg.fault_injection.seed}, "
+                f"rate {rcfg.fault_injection.rate})", ranks=[0])
+        # saver.py's guarded writes consult the process-global injector slot.
+        # ALWAYS (re)install — installing None clears a previous engine's
+        # injector, so an injection-enabled engine torn down earlier in the
+        # process can't fail a later engine's checkpoint writes
+        install_injector(self.fault_injector)
+        self._guardrail = (
+            TrainingGuardrail(rcfg.max_consecutive_bad_steps, rcfg.rewind,
+                              self.telemetry)
+            if rcfg.enabled else None)
+        if self._guardrail is not None:
+            log_dist(
+                f"resilience: NaN guardrail on (skip, rewind after "
+                f"{rcfg.max_consecutive_bad_steps} consecutive bad steps; "
+                "one overflow fetch per step)", ranks=[0])
+        self._injected_scale: float | None = None  # nan_grads restore value
+
         self._acknowledge_compiler_managed_knobs(raw)
         self._enforce_elasticity(raw)
 
@@ -1334,6 +1365,24 @@ class DeepSpeedEngine:
             getattr(getattr(self.model, "config", None), "remat_offload", False)
             or self.offload_param_enabled
         )
+        if (jax.default_backend() == "cpu"
+                and (mixes_spaces or self.offload_optimizer_enabled)
+                and "donate_argnums" in kwargs):
+            # XLA:CPU zero-copy/donation hazard (the test_offload transient-
+            # NaN flake, root-caused in PR 4): programs carrying host memory
+            # spaces (compute_on('device_host') regions / offload
+            # placements) can hand back output buffers whose backing memory
+            # is not XLA-owned for the array's full lifetime on the CPU
+            # backend; DONATING those buffers into the next step turns heap
+            # churn into silent param corruption (1-2 garbage steps, 2/8
+            # suite runs — 0/8 with donation off; _verify_state_shardings'
+            # per-step device_put re-placement was accidentally laundering
+            # most leaves, which is why the flake was intermittent). The CPU
+            # backend is the 8-virtual-device TEST harness: forgoing
+            # donation there costs only transient test memory. Accelerator
+            # backends copy host->HBM (no zero-copy aliasing) and keep
+            # donation — on TPU it is what makes resident state fit.
+            kwargs.pop("donate_argnums")
         self._mixes_spaces = mixes_spaces
         self._check_output_shardings = mixes_spaces
         self._last_batch_shapes = None
@@ -1402,6 +1451,7 @@ class DeepSpeedEngine:
         time 5:1 on a tunneled chip (experiments/perf_probe4.py) — steps chain
         asynchronously instead, and overflow accounting catches up lazily.
         """
+        self._resilience_pre_step()
         if self._nvme_offload:
             return self._train_batch_nvme(batch)
         if self._onebit_cfg is not None:
@@ -1496,7 +1546,67 @@ class DeepSpeedEngine:
                 ]
             )
         self._train_telemetry(batch, metrics if need_host else None, _sp.dur_s)
+        self._resilience_post_step(metrics)
         return metrics
+
+    # ------------------------------------------------------------------
+    # Resilience hooks (resilience/; docs/resilience.md)
+    # ------------------------------------------------------------------
+    def _resilience_pre_step(self) -> None:
+        """Fault-injection sites that fire BEFORE a step is dispatched:
+        simulated preemption (state is the consistent post-previous-step
+        state — checkpoint and exit), and the nan_grads site."""
+        inj = self.fault_injector
+        if inj is None:
+            return
+        step1 = self.global_steps + 1
+        if inj.preempt(step1):
+            from ..resilience import PreemptionSignal
+
+            self.telemetry.counter("resilience/preemptions").inc()
+            raise PreemptionSignal(step=self.global_steps)
+        if inj.nan_grads(step1):
+            # transient poison: a non-finite loss scale makes the step's
+            # loss/gradients genuinely non-finite INSIDE the compiled program
+            # (finite=False -> the update is skipped on-device) without
+            # changing the program or touching params; the scale is restored
+            # right after dispatch, so only this one step is faulted
+            self._injected_scale = float(jax.device_get(self.state["loss_scale"]))
+            self.state["loss_scale"] = jax.device_put(
+                jnp.asarray(float("inf"), jnp.float32),
+                self._state_shardings["loss_scale"])
+            self.telemetry.counter("resilience/injected_nan_steps").inc()
+
+    def _resilience_post_step(self, metrics, overflow: bool | None = None) -> None:
+        """Restore an injected loss scale; when the guardrail is armed,
+        track the NaN/overflow streak and escalate skip -> rewind ->
+        diverged. The overflow fetch is the guardrail's documented per-step
+        sync cost (``resilience.enabled``)."""
+        if self._injected_scale is not None:
+            self.state["loss_scale"] = jax.device_put(
+                jnp.asarray(self._injected_scale, jnp.float32),
+                self._state_shardings["loss_scale"])
+            self._injected_scale = None
+        if self._guardrail is None:
+            return
+        if overflow is None:
+            overflow = bool(np.asarray(jax.device_get(metrics["overflow"])))
+        action = self._guardrail.observe(overflow)
+        if action == "rewind":
+            d, t = self._guardrail.last_good
+            logger.warning(
+                "resilience: %d consecutive non-finite steps — rewinding to "
+                "checkpoint %s/%s", self._guardrail.bad_streak, d, t)
+            self.load_checkpoint(d, t)
+            self._guardrail.rewound()
+        elif action == "diverged":
+            from ..resilience import TrainingDivergedError
+
+            self.telemetry.counter("resilience/diverged").inc()
+            raise TrainingDivergedError(
+                f"{self._guardrail.bad_streak} consecutive non-finite steps "
+                "and no rewind target (save a checkpoint, or disable "
+                "resilience.rewind to keep skipping)")
 
     def _train_telemetry(self, batch, metrics_host, step_dur: float) -> None:
         """Per-step registry updates. Scalar gauges (loss/lr/grad-norm/scale)
@@ -1621,6 +1731,7 @@ class DeepSpeedEngine:
         # the NVMe path is synchronous (per-step host Adam): metrics are
         # already on host, so the gauges update every step
         self._train_telemetry(batch, metrics, time.perf_counter() - t_step)
+        self._resilience_post_step(metrics, overflow=overflow)
         return metrics
 
     def _maybe_quantize_weights(self):
@@ -1901,7 +2012,40 @@ class DeepSpeedEngine:
             f"saved checkpoint {save_dir}/{tag}" + (" (async)" if self._ckpt_async else ""),
             ranks=[0],
         )
+        if self._guardrail is not None:
+            # the rewind target — only trusted when saved outside a bad streak
+            self._guardrail.note_checkpoint(save_dir, tag)
+        self._prune_checkpoints(save_dir, current=tag)
         return True
+
+    def _prune_checkpoints(self, save_dir: str, current: str) -> None:
+        """keep-last-k retention (checkpoint.keep_last_k; 0 = keep all):
+        after each save, older committed tags beyond k are removed. The
+        just-saved tag, the 'latest'-pointed tag, and the guardrail's rewind
+        target are always kept. Process 0 only (it owns the tag namespace,
+        exactly like the manifest/'latest' writes)."""
+        k = self.config.checkpoint.keep_last_k
+        if k <= 0 or jax.process_index() != 0:
+            return
+        from ..checkpoint.saver import find_checkpoints
+
+        keep = {current}
+        latest_path = os.path.join(save_dir, "latest")
+        if os.path.exists(latest_path):
+            keep.add(open(latest_path).read().strip())
+        if self._guardrail is not None and self._guardrail.last_good:
+            gdir, gtag = self._guardrail.last_good
+            if os.path.abspath(gdir) == os.path.abspath(save_dir):
+                keep.add(gtag)
+        tags = find_checkpoints(save_dir)  # newest manifest first
+        for i, tag in enumerate(tags):
+            if i < k or tag in keep:
+                continue
+            import shutil
+
+            shutil.rmtree(os.path.join(save_dir, tag), ignore_errors=True)
+            log_dist(f"pruned checkpoint {save_dir}/{tag} (keep_last_k={k})",
+                     ranks=[0])
 
     def load_universal_checkpoint(self, load_dir: str, tag: Optional[str] = None):
         """Load a checkpoint saved under ANY topology (reference
@@ -1960,7 +2104,27 @@ class DeepSpeedEngine:
         log_dist(f"saved 16bit model weights to {path}", ranks=[0])
         return True
 
-    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None):
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        fallback_to_intact: bool = True,
+                        verify: Optional[bool] = None):
+        """Restore engine state from ``load_dir``. With ``tag=None`` the
+        'latest' tag is followed; if that checkpoint fails integrity
+        verification (``CheckpointCorruptError`` — torn write, digest
+        mismatch) and ``fallback_to_intact`` is set, the newest *intact*
+        sibling tag is loaded instead of crashing (docs/resilience.md). An
+        explicitly requested ``tag`` never falls back — the caller asked for
+        that checkpoint specifically. Missing checkpoints raise typed
+        ``CheckpointNotFoundError``. ``verify`` (default: the
+        ``checkpoint.verify_integrity`` config) controls the pre-load digest
+        pass — it reads every checkpoint byte, so large checkpoints on
+        trusted storage may opt out; the fallback scan always verifies
+        (an unverified fallback could hand back the very corruption the
+        scan exists to avoid)."""
+        from ..resilience import CheckpointCorruptError, CheckpointNotFoundError
+
+        if verify is None:
+            verify = self.config.checkpoint.verify_integrity
+        explicit = tag is not None
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.exists(latest):
@@ -1968,9 +2132,51 @@ class DeepSpeedEngine:
                 return None, {}
             tag = open(latest).read().strip()
         self.checkpoint_engine.commit()  # don't read past an in-flight save
-        state, client_state = self.checkpoint_engine.load(
-            os.path.join(load_dir, tag), self.state, self._state_shardings
-        )
+        try:
+            state, client_state = self.checkpoint_engine.load(
+                os.path.join(load_dir, tag), self.state, self._state_shardings,
+                verify=verify,
+            )
+        except (CheckpointCorruptError, CheckpointNotFoundError) as err:
+            if explicit or not fallback_to_intact:
+                raise
+            from ..checkpoint.saver import find_checkpoints
+
+            logger.error(
+                "checkpoint %s/%s failed to load (%s); scanning for the "
+                "newest intact checkpoint", load_dir, tag, err)
+            state = None
+            for cand in find_checkpoints(load_dir):
+                if cand == tag:
+                    continue
+                try:
+                    state, client_state = self.checkpoint_engine.load(
+                        os.path.join(load_dir, cand), self.state,
+                        self._state_shardings, verify=True)
+                except CheckpointCorruptError as e2:
+                    logger.warning("checkpoint %s/%s also corrupt (%s); "
+                                   "continuing scan", load_dir, cand, e2)
+                    continue
+                self.telemetry.counter("resilience/ckpt_fallbacks").inc()
+                self.telemetry.counter("resilience/recovered").inc()
+                logger.warning(
+                    "resilience: fell back from torn checkpoint %r to intact "
+                    "%r", tag, cand)
+                # repoint 'latest' at the tag actually loaded: otherwise
+                # every restart re-digests the corrupt tag and rescans, and
+                # _prune_checkpoints keeps protecting the corrupt tag while
+                # the intact one ages out of keep_last_k
+                if jax.process_index() == 0:
+                    from ..checkpoint.saver import write_latest
+
+                    write_latest(os.path.join(load_dir, "latest"), cand)
+                tag = cand
+                break
+            if state is None:
+                raise CheckpointCorruptError(
+                    f"no intact checkpoint under {load_dir} "
+                    f"(latest {tag!r} and every fallback failed "
+                    f"verification)", path=load_dir) from err
         self.state = state
         self.global_steps = client_state.get("global_steps", int(jax.device_get(state["step"])))
         self.global_samples = client_state.get("global_samples", 0)

@@ -90,7 +90,23 @@ class TensorSwapper:
         path = os.path.join(self.swap_dir, f"swap{sid:06d}.bin")
         offset = 0
         for i, leaf in enumerate(leaves):
-            arr = np.ascontiguousarray(np.asarray(jax.device_get(leaf)))
+            # UNCONDITIONAL DEFENSIVE COPY (offload transient-NaN hazard,
+            # PR 4): jax.device_get can return a zero-copy VIEW of the live
+            # XLA buffer (ascontiguousarray keeps the alias, and the view
+            # need not expose a .base to test for). Handing that pointer to
+            # the native aio worker threads ties their I/O lifetime to XLA's
+            # allocator: once the jax value is donated/freed, the same pages
+            # can back a different array while a straggling native access
+            # (late teardown, failed-fsync retry) still touches them. The
+            # PRIMARY fix for the observed flake is donation-off for
+            # host-space programs (runtime/engine.py) + checkpoint-load
+            # laundering (checkpoint/saver.py); this copy closes the same
+            # aliasing class at the native-I/O boundary. Cost: one memcpy
+            # per leaf per swap, dwarfed by the disk write. (order="C" +
+            # copy=True yields the contiguous copy in ONE memcpy — an outer
+            # ascontiguousarray would double-copy non-contiguous leaves.)
+            arr = np.array(np.asarray(jax.device_get(leaf)),
+                           order="C", copy=True)
             bufs.append(arr)
             entries.append(
                 {"offset": offset, "nbytes": arr.nbytes, "dtype": str(arr.dtype),

@@ -11,6 +11,8 @@ Pretty-prints, for CI logs and bench triage:
     events,
   * the serving prefix-cache table (hit rate, tokens reused, pool occupancy,
     resident entries) when the run's snapshot carries one,
+  * the resilience table (``resilience/*`` recovery/degradation counters,
+    fault-injector fired/opportunity ratios, non-ok request statuses),
   * the last registry ``snapshot`` event, if the run emitted one.
 
 Pure stdlib + host-side: safe to run anywhere the JSONL landed (no jax
@@ -148,6 +150,35 @@ def summarize(events: list[dict], top: int = 10) -> str:
                     f"{e['pool_slot']:>10}")
             if len(entries) > top:
                 lines.append(f"  ... +{len(entries) - top} more entries")
+        lines.append("")
+
+    # -- resilience -----------------------------------------------------
+    # recovery/degradation events (resilience/* counters) + injector stats,
+    # rendered as their own table so a faulted run's triage starts here
+    res_counters = {}
+    if snap is not None:
+        for name, v in snap.get("metrics", {}).get("counters", {}).items():
+            if name.startswith("resilience/"):
+                res_counters[name.split("/", 1)[1]] = v
+    fi = snap.get("fault_injection") if snap is not None else None
+    if res_counters or fi:
+        lines.append("resilience:")
+        if res_counters:
+            lines.append("  " + " ".join(
+                f"{k}={v:g}" for k, v in sorted(res_counters.items())))
+        if fi:
+            inj = fi.get("injected", {})
+            opp = fi.get("opportunities", {})
+            lines.append("  injected: " + (" ".join(
+                f"{site}={inj[site]}/{opp.get(site, 0)}"
+                for site in sorted(opp)) or "none"))
+        statuses = defaultdict(int)
+        for ev in events:
+            if ev.get("type") == "request" and ev.get("status", "ok") != "ok":
+                statuses[ev["status"]] += 1
+        if statuses:
+            lines.append("  degraded requests: " + " ".join(
+                f"{k}={v}" for k, v in sorted(statuses.items())))
         lines.append("")
 
     if snap is not None:

@@ -3,6 +3,7 @@ scheduler.py:27 — experiment search over zero stage / micro-batch / remat,
 collapsed to in-process compiled-trial measurement on TPU."""
 
 import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -12,6 +13,15 @@ from deepspeed_tpu.autotuning import Autotuner
 from deepspeed_tpu.models.transformer import Model, TransformerConfig
 
 V, S, B = 128, 64, 8
+
+# subprocess trials (ExperimentScheduler) don't inherit conftest's in-process
+# jax_compilation_cache_dir — point them at the same persistent cache via the
+# env var so warm suite runs skip the trial's XLA compile (tier-1 budget)
+SUBPROC_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "JAX_COMPILATION_CACHE_DIR": os.path.join(
+        os.path.dirname(__file__), ".xla_cache"),
+}
 
 
 def _model_factory(overrides):
@@ -64,7 +74,7 @@ def test_experiment_scheduler_isolates_failures_and_resumes(tmp_path):
     from deepspeed_tpu.autotuning import ExperimentScheduler
 
     sched = ExperimentScheduler(str(tmp_path), trial_timeout=300,
-                                env={"JAX_PLATFORMS": "cpu"})
+                                env=dict(SUBPROC_ENV))
     good = {"model_cfg": MODEL_CFG, "ds_config": dict(BASE),
             "batch": {"size": B, "seq": S, "vocab": V}, "steps": 1, "warmup": 0}
     rec = sched.run_trial(good)
@@ -93,7 +103,7 @@ def test_tune_isolated_surrogate_search(tmp_path):
 
     tuner = Autotuner(_model_factory, BASE, _batch_factory, steps=1, warmup=0)
     sched = ExperimentScheduler(str(tmp_path), trial_timeout=300,
-                                env={"JAX_PLATFORMS": "cpu"})
+                                env=dict(SUBPROC_ENV))
     space = {"zero_stage": [1, 7], "remat_policy": ["none"]}  # 7 = crash trial
     res = tuner.tune_isolated(
         MODEL_CFG, {"size": B, "seq": S, "vocab": V}, sched,

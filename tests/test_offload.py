@@ -175,6 +175,56 @@ def test_offload_param_nvme_tier(tmp_path):
     e = _param_offload_engine(nvme_dir=tmp_path)
     losses = [float(jax.device_get(e.train_batch(b)["loss"])) for _ in range(4)]
     assert losses[-1] < losses[0], losses
+    # the nvme tier owns native aio threads: tear them down NOW, not at a
+    # GC point inside a later test (the PR 3 suite-order-flake lesson)
+    e.nvme_opt.close()
+
+
+def test_nvme_then_param_offload_no_transient_nan(tmp_path):
+    """Regression for the offload transient-NaN hazard (ROADMAP open item,
+    root-caused and closed in PR 4): offload trainings intermittently read
+    NaN/garbage losses, worst after the nvme-tier tests had churned the
+    heap. ROOT CAUSE: on the XLA:CPU test backend, programs carrying host
+    memory spaces (compute_on('device_host') regions / offload placements)
+    can return buffers whose backing memory is not XLA-owned for the
+    array's lifetime; DONATING those buffers into the next step turned
+    heap churn into silent param corruption (A/B: 2/8 suite runs failing
+    with donation, 0/8 without; skipping the per-step device_put
+    re-placement — which was accidentally re-materializing most leaves —
+    made it 8/8). Fixes: host-space programs no longer donate state on the
+    CPU backend (runtime/engine.py _jit_step), checkpoint loads launder
+    numpy-backed arrays into XLA-owned buffers (checkpoint/saver.py), and
+    swap_tensor copies device_get views before handing them to native aio
+    threads (defense in depth for the same aliasing class).
+
+    This loops the ordering with the historically-highest repro rate:
+    nvme-tier create/train/drop (heap churn + native teardown), then
+    param-offload training whose every loss must be finite."""
+    pytest.importorskip("deepspeed_tpu.ops.aio")
+    from deepspeed_tpu.ops.aio import aio_available
+
+    if not aio_available():
+        pytest.skip("native aio unavailable")
+    import gc
+
+    b = _batch()
+    # 2 iterations, not more: the landed fix is deterministic (donation
+    # removed on the hazardous path), so looping buys ordering coverage,
+    # not detection probability — and the tier-1 budget is tight
+    for i in range(2):
+        e_nvme = _param_offload_engine(nvme_dir=tmp_path / str(i))
+        float(jax.device_get(e_nvme.train_batch(b)["loss"]))
+        e_nvme.nvme_opt.close()
+        del e_nvme
+        gc.collect()  # fire finalizers at the hazardous point, deliberately
+        e_cpu = _param_offload_engine(gas=1)
+        losses = [float(jax.device_get(e_cpu.train_batch(b)["loss"]))
+                  for _ in range(3)]
+        assert all(np.isfinite(losses)), (
+            f"iteration {i}: transient NaN in param_offload after nvme "
+            f"teardown: {losses}")
+        del e_cpu
+        gc.collect()
 
 
 def test_offload_param_pipeline_rejected():

@@ -1,0 +1,493 @@
+"""Resilience subsystem: the fault-injection matrix (docs/resilience.md).
+
+Every fault the injector can raise has a test here where the workload
+*completes correctly anyway*:
+
+  * training NaN/Inf  -> the faulted step is skipped on-device and the
+                         trajectory bitwise-matches a clean run
+  * consecutive NaNs  -> rewind to the last good checkpoint, then training
+                         continues from exactly that state
+  * preemption        -> checkpoint + restart resumes the identical run
+  * torn checkpoint   -> load falls back to the newest intact tag
+  * checkpoint IO err -> the save fails ATOMICALLY (no half-visible
+                         checkpoint, 'latest' untouched)
+  * garbage logits    -> the serving request is quarantined + replayed and
+                         every surviving request is greedy-token-identical
+                         to an unfaulted run, under watchdog raise mode
+                         (recovery never traces a new decode program)
+
+Speed: serving tests share the session-scoped ``tiny_serving_engine``
+fixture (same model config = same cached XLA programs as test_serving /
+test_prefix_cache) and training tests reuse test_checkpoint's engine shapes.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.serving import Request as ServingRequest
+from deepspeed_tpu.inference.serving import ServingEngine
+from deepspeed_tpu.models.transformer import Model, TransformerConfig
+from deepspeed_tpu.resilience import (
+    CheckpointCorruptError,
+    CheckpointNotFoundError,
+    FaultInjector,
+    PreemptionSignal,
+    RequestRejected,
+    TrainingDivergedError,
+    clear_injector,
+    install_injector,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clear_global_injector():
+    """Engines with fault injection install a process-global injector for
+    the saver's guarded writes — never leak it into later tests."""
+    yield
+    clear_injector()
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector unit tests (no jax, no device)
+# ---------------------------------------------------------------------------
+
+def test_injector_deterministic_lists_fire_once():
+    inj = FaultInjector({"enabled": True, "nan_grad_steps": [3],
+                         "preempt_steps": [5]})
+    assert [inj.nan_grads(s) for s in (1, 2, 3)] == [False, False, True]
+    # a rewound/replayed step is NOT re-faulted (transient-fault model)
+    assert inj.nan_grads(3) is False
+    assert inj.preempt(5) and not inj.preempt(5)
+    assert inj.injected["nan_grads"] == 1
+
+
+def test_injector_rate_mode_reproducible():
+    cfg = {"enabled": True, "rate": 0.3, "seed": 7, "sites": ["garbage_logits"]}
+    ia, ib = FaultInjector(cfg), FaultInjector(cfg)
+    a = [ia.garbage_logits(9, "decode", i) for i in range(40)]
+    b = [ib.garbage_logits(9, "decode", i) for i in range(40)]
+    assert a == b and any(a) and not all(a)
+    # sites allowlist gates rate mode
+    inj = FaultInjector(cfg)
+    assert not any(inj.nan_grads(s) for s in range(40))
+
+
+def test_guardrail_grants_one_rewind_per_bad_stretch():
+    """A fault that reproduces right after restore must escalate to
+    'diverged', not loop rewind -> re-fault -> rewind forever; a finite step
+    between stretches re-arms the rewind."""
+    from deepspeed_tpu.resilience import TrainingGuardrail
+
+    class _Counter:
+        def inc(self, n=1):
+            pass
+
+    class _TM:
+        def counter(self, name):
+            return _Counter()
+
+    g = TrainingGuardrail(max_consecutive_bad_steps=2, rewind=True, telemetry=_TM())
+    g.note_checkpoint("/d", "t0")
+    assert [g.observe(True), g.observe(True)] == ["skip", "rewind"]
+    g.rewound()
+    # restored state re-faults immediately: no second rewind, diverge
+    assert [g.observe(True), g.observe(True)] == ["skip", "diverged"]
+    # ... but a finite step in between re-arms it
+    g.observe(False)
+    assert [g.observe(True), g.observe(True)] == ["skip", "rewind"]
+
+
+def test_injector_io_error_typed_and_counted():
+    inj = FaultInjector({"enabled": True, "io_error_writes": [2]})
+    inj.io_error("/a")  # write #1: clean
+    with pytest.raises(OSError, match="fault injection.*#2"):
+        inj.io_error("/b")
+    inj.io_error("/c")  # the site fired once; the clock keeps counting
+    assert inj.stats()["guarded_writes"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Training guardrails
+# ---------------------------------------------------------------------------
+
+def _train_engine(resilience=None, ckpt=None):
+    # test_checkpoint.py's exact shapes: the train-step programs are already
+    # in tests/.xla_cache (resilience changes no compiled program)
+    cfg = TransformerConfig(
+        vocab_size=128, max_seq_len=32, num_layers=2, num_heads=4,
+        hidden_size=32, dtype=jnp.float32, loss_chunk_size=0,
+    )
+    ds = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3},
+        "steps_per_print": 10**9,
+        "mesh": {"data": 2, "fsdp": 4},
+    }
+    if resilience:
+        ds["resilience"] = resilience
+    if ckpt:
+        ds["checkpoint"] = ckpt
+    engine, _, _, _ = deepspeed_tpu.initialize(model=Model(cfg), config=ds)
+    return engine
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"tokens": rng.integers(0, 128, size=(8, 33)).astype(np.int32)}
+            for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def clean_trajectory():
+    """ONE clean engine trained over the shared batch schedule; the NaN-skip
+    and preemption tests both compare against its wte snapshots (engine
+    builds are the expensive part of this module — tier-1 budget)."""
+    bs = _batches(4)
+    clean = _train_engine()
+    wte = {}
+    for i, b in enumerate(bs):
+        clean.train_batch(b)
+        if i in (2, 3):
+            wte[i + 1] = np.asarray(
+                jax.device_get(clean.state["params"]["wte"])).copy()
+    steps = clean.get_global_step()
+    del clean
+    return {"wte": wte, "final_steps": steps}
+
+
+def test_nan_skip_matches_clean_run(clean_trajectory):
+    """An injected non-finite step is skipped ON DEVICE (params, optimizer
+    state and the step counter untouched) — afterwards the run is bitwise
+    identical to one that never saw the fault."""
+    bs = _batches(5)
+    faulted = _train_engine({"enabled": True,
+                             "fault_injection": {"enabled": True,
+                                                 "nan_grad_steps": [3]}})
+    # same data, plus a sacrificial batch consumed by the skipped step
+    for b in bs[:2] + [bs[4]] + bs[2:4]:
+        faulted.train_batch(b)
+
+    assert faulted.skipped_steps == 1
+    assert faulted.get_global_step() == clean_trajectory["final_steps"]
+    pb = jax.device_get(faulted.state["params"]["wte"])
+    np.testing.assert_array_equal(clean_trajectory["wte"][4], np.asarray(pb))
+    counters = faulted.telemetry.registry.snapshot()["counters"]
+    assert counters["resilience/nan_skipped_steps"] == 1
+    assert counters["resilience/recovered"] == 1
+
+
+def test_rewind_after_consecutive_bad_steps_and_retention(tmp_path):
+    """max_consecutive_bad_steps faulted steps -> the engine reloads the
+    last good checkpoint and resumes from exactly that state; keep_last_k
+    prunes older tags but never the rewind target."""
+    bs = _batches(6)
+    e = _train_engine(
+        {"enabled": True, "max_consecutive_bad_steps": 2,
+         "fault_injection": {"enabled": True, "nan_grad_steps": [3, 4]}},
+        ckpt={"keep_last_k": 1},
+    )
+    d = str(tmp_path)
+    e.train_batch(bs[0])
+    e.save_checkpoint(d, tag="g1")
+    e.train_batch(bs[1])
+    e.save_checkpoint(d, tag="g2")
+    ref = np.asarray(jax.device_get(e.state["params"]["wte"])).copy()
+
+    e.train_batch(bs[2])  # faulted: skip (streak 1)
+    e.train_batch(bs[3])  # faulted: streak 2 -> rewind to g2
+    got = np.asarray(jax.device_get(e.state["params"]["wte"]))
+    np.testing.assert_array_equal(ref, got)
+    assert e.global_steps == 2 and e.get_global_step() == 2
+    counters = e.telemetry.registry.snapshot()["counters"]
+    assert counters["resilience/rewinds"] == 1
+
+    # post-rewind training continues finitely from the restored state
+    # (load-then-train bitwise parity itself is proven by the preemption
+    # test's restart — same load path, same optimizer-state restore)
+    m = e.train_batch(bs[4])
+    assert np.isfinite(float(jax.device_get(m["loss"])))
+    assert e.global_steps == 3
+
+    # retention: keep_last_k=1 pruned g1; g2 survives as newest + latest +
+    # rewind target
+    assert not os.path.exists(os.path.join(d, "g1"))
+    assert os.path.exists(os.path.join(d, "g2"))
+
+
+def test_preemption_checkpoint_restart_resumes_identically(
+        tmp_path, clean_trajectory):
+    """Preempt -> save -> "new process" loads 'latest' and resumes the
+    bitwise-identical trajectory. The restarted engine then also covers the
+    torn-'latest' fallback: a later tag is corrupted after the fact and
+    load_checkpoint falls back to the intact one (sharing the engine keeps
+    this module inside the tier-1 budget)."""
+    import time
+
+    bs = _batches(4)
+    d = str(tmp_path)
+    e = _train_engine({"enabled": True,
+                       "fault_injection": {"enabled": True,
+                                           "preempt_steps": [2]}})
+    e.train_batch(bs[0])
+    with pytest.raises(PreemptionSignal):
+        e.train_batch(bs[1])  # raised BEFORE dispatch: state is step-1 state
+    e.save_checkpoint(d, tag="pre")
+
+    restarted = _train_engine()  # the "new process"
+    tag, _ = restarted.load_checkpoint(d)
+    assert tag == "pre"
+    restarted.train_batch(bs[1])
+    restarted.train_batch(bs[2])
+    np.testing.assert_array_equal(
+        clean_trajectory["wte"][3],
+        np.asarray(jax.device_get(restarted.state["params"]["wte"])))
+
+    # torn 'latest' falls back to the newest intact tag (and counts it)
+    time.sleep(0.05)  # distinct manifest mtimes order the fallback scan
+    restarted.save_checkpoint(d, tag="post")
+    npys = [f for f in os.listdir(os.path.join(d, "post"))
+            if f.endswith(".npy")]
+    with open(os.path.join(d, "post", npys[0]), "r+b") as f:
+        f.seek(16)
+        f.write(b"\x00\x01\x02\x03")
+    tag, _ = restarted.load_checkpoint(d)
+    assert tag == "pre"
+    # 'latest' is repointed at the tag actually loaded: restarts must not
+    # re-digest the corrupt tag (nor keep protecting it from pruning)
+    assert open(os.path.join(d, "latest")).read().strip() == "pre"
+    counters = restarted.telemetry.registry.snapshot()["counters"]
+    assert counters["resilience/ckpt_fallbacks"] == 1
+    # an explicitly requested torn tag never falls back
+    with pytest.raises(CheckpointCorruptError):
+        restarted.load_checkpoint(d, tag="post")
+
+
+def test_diverged_without_rewind_target_is_typed():
+    e = _train_engine({"enabled": True, "max_consecutive_bad_steps": 1,
+                       "fault_injection": {"enabled": True,
+                                           "nan_grad_steps": [1]}})
+    with pytest.raises(TrainingDivergedError):
+        e.train_batch(_batches(1)[0])
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity (saver-level: no engine needed)
+# ---------------------------------------------------------------------------
+
+def _tiny_state():
+    return {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+            "step": jnp.int32(7)}
+
+
+def test_atomic_save_writes_checksums_and_verifies(tmp_path):
+    from deepspeed_tpu.checkpoint import saver
+
+    d = str(tmp_path / "t0")
+    saver.save_checkpoint(d, _tiny_state(), latest=(str(tmp_path / "latest"), "t0"))
+    assert not os.path.exists(d + ".tmp")  # staging dir renamed away
+    manifest = saver.verify_checkpoint(d)
+    assert manifest["format"] == 3 and manifest["checksums"]
+    state, _ = saver.load_checkpoint(d, _tiny_state())
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.asarray(_tiny_state()["w"]))
+
+
+def test_corrupt_and_missing_are_typed(tmp_path):
+    from deepspeed_tpu.checkpoint import saver
+
+    with pytest.raises(CheckpointNotFoundError):
+        saver.read_manifest(str(tmp_path / "never_saved"))
+    d = str(tmp_path / "t0")
+    saver.save_checkpoint(d, _tiny_state())
+    # flip bytes in the array payload: digest verification must catch it
+    fname = [f for f in os.listdir(d) if f.endswith(".npy")][0]
+    with open(os.path.join(d, fname), "r+b") as f:
+        f.seek(12)
+        f.write(b"\xff\xff\xff\xff")
+    with pytest.raises(CheckpointCorruptError) as ei:
+        saver.load_checkpoint(d, _tiny_state())
+    assert fname in str(ei.value)
+    # a deleted shard file is torn, not missing
+    os.remove(os.path.join(d, fname))
+    with pytest.raises(CheckpointCorruptError):
+        saver.verify_checkpoint(d)
+
+
+def test_io_error_injection_keeps_save_atomic(tmp_path):
+    from deepspeed_tpu.checkpoint import saver
+
+    good = str(tmp_path / "good")
+    saver.save_checkpoint(good, _tiny_state(),
+                          latest=(str(tmp_path / "latest"), "good"))
+    install_injector(FaultInjector({"enabled": True, "io_error_writes": [1]}))
+    bad = str(tmp_path / "bad")
+    with pytest.raises(OSError, match="fault injection"):
+        saver.save_checkpoint(bad, _tiny_state(),
+                              latest=(str(tmp_path / "latest"), "bad"))
+    clear_injector()
+    # ATOMIC failure: no committed checkpoint, 'latest' untouched, and the
+    # intact sibling still loads
+    with pytest.raises(CheckpointNotFoundError):
+        saver.read_manifest(bad)
+    assert open(tmp_path / "latest").read() == "good"
+    saver.load_checkpoint(good, _tiny_state())
+    # the staging leftovers are reclaimed by the next save to the same tag
+    saver.save_checkpoint(bad, _tiny_state())
+    saver.verify_checkpoint(bad)
+
+
+# ---------------------------------------------------------------------------
+# Serving degradation (shared tiny_serving_engine: cached XLA programs)
+# ---------------------------------------------------------------------------
+
+def _prompts():
+    rng = np.random.default_rng(42)
+    return [rng.integers(1, 97, size=(s,)).astype(np.int32)
+            for s in (7, 12, 9, 5)]
+
+
+def _reqs(**over):
+    return [ServingRequest(uid=i, prompt=p, max_new_tokens=6, **over)
+            for i, p in enumerate(_prompts())]
+
+
+@pytest.fixture(scope="module")
+def clean_tokens(tiny_serving_engine):
+    """Greedy reference output for _reqs() with no faults — the parity
+    baseline every degradation test compares against."""
+    srv = ServingEngine(tiny_serving_engine, n_slots=4, max_seq_len=128)
+    res = srv.serve(_reqs())
+    return {u: r.tokens.tolist() for u, r in res.items()}
+
+
+def test_quarantine_requeue_greedy_parity_watchdog_raise(
+        tiny_serving_engine, clean_tokens):
+    """Decode-phase NaN-logit fault: the poisoned request is quarantined,
+    replayed cleanly, and EVERY result matches the unfaulted run — under
+    watchdog raise mode, proving recovery (poison, scrub, requeue, slot
+    reuse) never traces a second decode program."""
+    srv = ServingEngine(
+        tiny_serving_engine, n_slots=4, max_seq_len=128,
+        config={"watchdog_mode": "raise",
+                "fault_injection": {"enabled": True,
+                                    "garbage_logits_uids": [2],
+                                    "garbage_logits_phase": "decode",
+                                    "garbage_logits_decode_step": 1}})
+    res = srv.serve(_reqs())
+    assert {u: r.tokens.tolist() for u, r in res.items()} == clean_tokens
+    assert all(r.status == "ok" for r in res.values())
+    assert res[2].requeues == 1
+    assert srv.compile_counts()["decode"] == 1
+    counters = srv.telemetry.registry.snapshot()["counters"]
+    assert counters["resilience/quarantines"] == 1
+    assert counters["resilience/recovered"] == 1
+    assert srv.n_free == srv.n_slots  # no slot leak
+
+
+def test_prefill_fault_never_poisons_prefix_cache(
+        tiny_serving_engine, clean_tokens):
+    """Prefill-phase fault with the prefix cache on: the faulted prefill's
+    KV must NOT be stored (poison protection), the request replays cleanly,
+    and outputs match the unfaulted baseline."""
+    srv = ServingEngine(
+        tiny_serving_engine, n_slots=4, max_seq_len=128,
+        config={"prefix_cache": {"enabled": True, "n_slots": 4, "block": 4},
+                "fault_injection": {"enabled": True,
+                                    "garbage_logits_uids": [1],
+                                    "garbage_logits_phase": "prefill"}})
+    res = srv.serve(_reqs())
+    assert {u: r.tokens.tolist() for u, r in res.items()} == clean_tokens
+    stats = srv.prefix_cache_stats()
+    # 3 clean first-pass prompts + uid 1's clean REPLAY inserted; the
+    # faulted prefill itself never reached the pool
+    assert stats["inserts"] == 4
+    counters = srv.telemetry.registry.snapshot()["counters"]
+    assert counters["resilience/nan_logit_faults"] == 1
+
+
+def test_deadline_evicts_without_disturbing_survivors(
+        tiny_serving_engine, clean_tokens):
+    """A hopeless request (deadline far shorter than its decode) is evicted
+    mid-flight with its partial output; survivors' greedy tokens are
+    untouched and the slot returns to the pool."""
+    reqs = _reqs()
+    reqs[1] = ServingRequest(uid=1, prompt=reqs[1].prompt,
+                             max_new_tokens=110, deadline_s=0.15)
+    srv = ServingEngine(tiny_serving_engine, n_slots=4, max_seq_len=128)
+    res = srv.serve(reqs)
+    assert res[1].status == "deadline_exceeded"
+    assert len(res[1].tokens) < 110
+    for u in (0, 2, 3):
+        assert res[u].status == "ok"
+        assert res[u].tokens.tolist() == clean_tokens[u]
+    assert srv.n_free == srv.n_slots
+    counters = srv.telemetry.registry.snapshot()["counters"]
+    assert counters["resilience/deadline_evictions"] == 1
+
+
+def test_load_shed_typed_and_bounded(tiny_serving_engine):
+    srv = ServingEngine(tiny_serving_engine, n_slots=1, max_seq_len=128,
+                        config={"max_queue_len": 2})
+    p = _prompts()[0]
+    # serve(): shed requests complete with a typed status, others finish
+    res = srv.serve([ServingRequest(uid=i, prompt=p, max_new_tokens=4)
+                     for i in range(6)])
+    statuses = {r.status for r in res.values()}
+    assert "shed_queue_full" in statuses and "ok" in statuses
+    assert all(r.tokens.tolist() == res[0].tokens.tolist()
+               for r in res.values() if r.status == "ok")
+    # direct submit(): typed exception once the arrived backlog is full
+    srv.submit(ServingRequest(uid=10, prompt=p, max_new_tokens=4))
+    srv.submit(ServingRequest(uid=11, prompt=p, max_new_tokens=4))
+    with pytest.raises(RequestRejected) as ei:
+        srv.submit(ServingRequest(uid=12, prompt=p, max_new_tokens=4))
+    assert ei.value.reason == "queue_full" and ei.value.uid == 12
+    srv.drain()
+    assert srv.n_free == 1
+
+
+def test_cancel_everywhere(tiny_serving_engine):
+    srv = ServingEngine(tiny_serving_engine, n_slots=1, max_seq_len=128)
+    p = _prompts()[0]
+    # mid-decode
+    srv.submit(ServingRequest(uid=0, prompt=p, max_new_tokens=60))
+    srv.step(now=0.0)
+    srv.step(now=0.0)
+    assert srv.cancel(0)
+    # queued (slot occupied by nothing now; submit + cancel before any step)
+    srv.submit(ServingRequest(uid=1, prompt=p, max_new_tokens=4))
+    assert srv.cancel(1)
+    assert not srv.cancel(99)
+    res = srv.drain()
+    assert res[0].status == "cancelled" and len(res[0].tokens) >= 1
+    assert res[1].status == "cancelled" and len(res[1].tokens) == 0
+    assert srv.n_free == 1 and srv.n_active == 0
+
+
+def test_slot_quarantine_pulls_suspect_slot(tiny_serving_engine):
+    """Two consecutive faulted requests in the single faulty 'lane' (slot 0
+    of a 2-slot engine) quarantine the slot; the engine keeps serving on the
+    remaining slot and never quarantines its last healthy one."""
+    srv = ServingEngine(
+        tiny_serving_engine, n_slots=2, max_seq_len=128,
+        config={"quarantine_max_requeues": 0,  # every fault fails fast
+                "slot_quarantine_after": 2,
+                "fault_injection": {"enabled": True,
+                                    "garbage_logits_uids": [0, 1, 2],
+                                    "garbage_logits_phase": "prefill"}})
+    p = _prompts()
+    # serialize admissions so the faults land in the same slot repeatedly
+    for uid in (0, 1, 2):
+        srv.submit(ServingRequest(uid=uid, prompt=p[0], max_new_tokens=3))
+        srv.drain()
+    assert len(srv.quarantined_slots) == 1
+    res = srv.serve([ServingRequest(uid=5, prompt=p[1], max_new_tokens=3)])
+    assert res[5].status == "ok"  # still serving on the surviving slot
+    assert srv.n_free + len(srv.quarantined_slots) == 2
