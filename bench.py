@@ -35,6 +35,18 @@ ASSERTS the resilience contract — every request reaches a terminal status,
 ``resilience/recovered`` is non-zero (at least one quarantined request's
 clean replay finished), and no slot leaks (occupancy gauge back to 0, every
 non-quarantined slot back in the free pool). Prints one JSON line.
+
+Chaos soak drill (``python bench.py --chaos [steps] [--chaos-seed N]``, CI
+tier): a supervisor loop trains a tiny model to a target step count under
+seeded random preemptions (each takes a just-in-time ``preempt``-tag
+checkpoint and kills the generation), one NaN step, and a transient
+``io_flaky`` checkpoint-write fault, relaunching a fresh engine from
+'latest' after every preemption. ASSERTS the elastic contract: >= 2
+preemptions and >= 1 retried write survived, the survivor reaches the
+target step count, and its final-step loss is BITWISE the clean
+uninterrupted run's (batches are keyed on the device step, so skip/resume
+replay exactly the data the clean run saw). Prints one JSON line with
+preemption/resume/retry counts.
 """
 
 import json
@@ -269,6 +281,145 @@ def _fault_smoke(rate: float) -> int:
     return 0
 
 
+def _chaos(steps: int, seed: int) -> int:
+    """Chaos soak drill (see module docstring): preempt/NaN/io_flaky faults
+    with relaunches must reach the same step count and final-step loss as a
+    clean run. In-process and CPU-pinned — a correctness soak, not a
+    throughput number."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import random
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import Model, TransformerConfig
+    from deepspeed_tpu.resilience import PreemptionSignal
+
+    t0 = time.perf_counter()
+    B, V, S = 8, 128, 32
+
+    def build_engine(fault_cfg=None, save_dir=""):
+        cfg = TransformerConfig(
+            vocab_size=V, max_seq_len=S, num_layers=2, num_heads=4,
+            hidden_size=32, dtype=jnp.float32, loss_chunk_size=0,
+        )
+        ds = {
+            "train_batch_size": B,
+            "train_micro_batch_size_per_gpu": B,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10**9,
+            "mesh": {"data": -1},
+        }
+        if fault_cfg is not None:
+            ds["resilience"] = {
+                "enabled": True,
+                "max_consecutive_bad_steps": 3,
+                "preemption": {"enabled": False, "save_dir": save_dir,
+                               "tag": "preempt"},
+                "retry": {"max_attempts": 3, "base_delay_s": 0.01,
+                          "max_delay_s": 0.05},
+                "fault_injection": {"enabled": True, "seed": seed,
+                                    **fault_cfg},
+            }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=Model(cfg), config=ds)
+        return engine
+
+    def batch_for(step):
+        # DEVICE-step-keyed deterministic data: a skipped/preempted step is
+        # re-drawn on replay, so the applied-update sequence — and therefore
+        # the final loss — is bitwise the clean run's
+        rng = np.random.default_rng(seed * 100003 + step)
+        return {"tokens": rng.integers(0, V, size=(B, S + 1)).astype(np.int32)}
+
+    # -- clean reference run -----------------------------------------------
+    clean = build_engine()
+    m = None
+    while clean.get_global_step() < steps:
+        m = clean.train_batch(batch_for(clean.get_global_step()))
+    clean_loss = float(np.asarray(jax.device_get(m["loss"])))
+    assert clean.get_global_step() == steps
+
+    # -- chaos plan (seeded): 2 preemptions, 1 NaN step, 1 transient write --
+    plan_rng = random.Random(seed)
+    candidates = list(range(2, steps))
+    preempt_steps = sorted(plan_rng.sample(candidates, k=2))
+    nan_step = plan_rng.choice([s for s in candidates if s not in preempt_steps])
+
+    tallies = {"preemptions": 0, "resumes": 0, "ckpt_retries": 0,
+               "nan_skipped_steps": 0, "jit_checkpoints": 0}
+
+    def absorb(engine):
+        counters = engine.telemetry.registry.snapshot()["counters"]
+        for k in tallies:
+            tallies[k] += int(counters.get(f"resilience/{k}", 0))
+
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        remaining = list(preempt_steps)
+        generations = 0
+        final_loss = None
+        while True:
+            generations += 1
+            # a correct run is bounded at 1 + planned preemptions; a
+            # recovery regression must FAIL the drill, not hang CI
+            assert generations <= len(preempt_steps) + 1, (
+                "relaunch loop exceeded the planned-preemption bound",
+                generations, tallies)
+            engine = build_engine(
+                {"preempt_steps": remaining, "nan_grad_steps": [nan_step],
+                 # only the first generation's JIT save hits the flaky write
+                 "io_flaky_writes": [1] if generations == 1 else []},
+                save_dir=ckpt_dir)
+            if generations > 1:
+                engine.load_checkpoint(ckpt_dir)  # 'latest' -> preempt tag
+            try:
+                m = None
+                while engine.get_global_step() < steps:
+                    m = engine.train_batch(batch_for(engine.get_global_step()))
+                final_loss = float(np.asarray(jax.device_get(m["loss"])))
+                absorb(engine)
+                break
+            except PreemptionSignal as e:
+                # transient-preemption model: the relaunched reservation is
+                # not re-evicted at the same instant — drop the fired step
+                remaining = [s for s in remaining if s != e.step + 1]
+                absorb(engine)
+                del engine
+        survivor_steps = steps
+
+    # -- the elastic contract, asserted ------------------------------------
+    assert tallies["preemptions"] >= 2, tallies
+    assert tallies["resumes"] >= 2, tallies
+    assert tallies["ckpt_retries"] >= 1, (
+        "the io_flaky transient write was never retried", tallies)
+    assert tallies["nan_skipped_steps"] >= 1, tallies
+    assert final_loss == clean_loss, (
+        f"survivor final-step loss {final_loss!r} != clean run "
+        f"{clean_loss!r} — resume is not bitwise")
+
+    print(json.dumps({
+        "metric": "chaos soak drill (injected faults survived)",
+        "value": int(tallies["preemptions"] + tallies["ckpt_retries"]
+                     + tallies["nan_skipped_steps"]),
+        "unit": "faults",
+        "target_steps": steps,
+        "survivor_steps": survivor_steps,
+        "generations": generations,
+        "preempt_steps": preempt_steps,
+        "nan_step": nan_step,
+        "final_loss": final_loss,
+        "clean_loss": clean_loss,
+        "loss_bitwise_match": final_loss == clean_loss,
+        "resilience": tallies,
+        "seed": seed,
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }), flush=True)
+    return 0
+
+
 def _extract_json_line(text):
     for line in reversed(text.splitlines()):
         line = line.strip()
@@ -413,6 +564,26 @@ if __name__ == "__main__":
                   file=sys.stderr)
             sys.exit(2)
         sys.exit(_fault_smoke(rate))
+    if "--chaos" in sys.argv:
+        # usage-error exit 2 on malformed values (same contract as
+        # --fault-rate): --chaos [steps >= 6] [--chaos-seed <int>]
+        try:
+            idx = sys.argv.index("--chaos")
+            steps = 12
+            if idx + 1 < len(sys.argv) and not sys.argv[idx + 1].startswith("--"):
+                # "--"-prefixed means the next FLAG; a bare "-3" is a (bad)
+                # steps value and must hit the usage check, not be ignored
+                steps = int(sys.argv[idx + 1])
+            chaos_seed = 0
+            if "--chaos-seed" in sys.argv:
+                chaos_seed = int(sys.argv[sys.argv.index("--chaos-seed") + 1])
+            if steps < 6:
+                raise ValueError("steps must be >= 6 (room for 2 preempts + 1 NaN)")
+        except (IndexError, ValueError) as e:
+            print(f"usage: bench.py --chaos [steps >= 6] [--chaos-seed <int>] ({e})",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_chaos(steps, chaos_seed))
     if os.environ.get(_CHILD_ENV) == "1":
         main()
     else:

@@ -63,6 +63,7 @@ import numpy as np
 
 from ..resilience.errors import CheckpointCorruptError, CheckpointNotFoundError
 from ..resilience.faults import maybe_io_error
+from .digest import file_crc32
 
 PyTree = Any
 _SEP = "::"
@@ -433,12 +434,7 @@ def verify_checkpoint(ckpt_dir: str, manifest: Optional[dict] = None,
                 f"checkpoint {ckpt_dir} is torn: missing {fname}", path=path)
         want = crcs.get(fname)
         if want is not None:
-            got = 0
-            with open(path, "rb") as f:
-                # chunked: a single read() would spike host RSS by the
-                # largest shard's size during the default-on pre-load pass
-                while chunk := f.read(1 << 20):
-                    got = zlib.crc32(chunk, got)
+            got = file_crc32(path)
             if got != want:
                 raise CheckpointCorruptError(
                     f"checkpoint {ckpt_dir} is corrupt: {fname} crc32 "

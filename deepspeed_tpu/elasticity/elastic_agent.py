@@ -15,10 +15,32 @@ on relaunch. So the agent is a small supervisor:
    reference), picking the micro-batch for that world,
 3. launch the worker command with the DSTPU_* env the launcher stack already
    consumes (launcher/launch.py:child_env),
-4. on worker death or membership change: terminate the tree, recompute, and
-   relaunch (bounded by ``max_restarts``); training state carries across via
-   checkpoint-resume (engine.save/load_checkpoint), which is the recovery
-   story on re-schedulable TPU jobs.
+4. on worker death, membership change, or a *stale heartbeat* (a wedged
+   worker that neither exits nor progresses): terminate the tree, recompute,
+   back off (bounded exponential + deterministic jitter — a crash-looping
+   worker must not hot-spin the supervisor), and relaunch (bounded by
+   ``max_restarts``); training state carries across via checkpoint-resume
+   (engine.save/load_checkpoint + the PreemptionGuard's JIT ``preempt``
+   checkpoints), which is the recovery story on re-schedulable TPU jobs.
+
+Heartbeats: when ``heartbeat_file`` is set the worker finds its path in
+``DSTPU_ELASTIC_HEARTBEAT`` and touches it at every step boundary (e.g.
+``os.utime(path)`` or ``pathlib.Path(path).touch()``). The agent re-creates
+the file at each launch and declares the worker hung once its mtime falls
+``heartbeat_timeout`` seconds behind — SIGKILL straight away (a wedged
+worker already ignored its chance to exit; SIGTERM first would just burn
+the grace window twice). A worker that has not yet touched the file at all
+is judged against ``heartbeat_grace`` (default 10x the timeout) instead:
+time-to-first-step includes cold XLA compiles, and a step-cadence timeout
+must not kill a healthy compiling worker.
+
+Exit codes (``run()`` return value — mirrored by ``bin/dstpu_elastic``):
+``0`` worker finished cleanly (possibly after restarts —
+``agent.restart_count`` says how many); the worker's last nonzero rc when
+``max_restarts`` is exhausted by failures; ``1`` when restarts are
+exhausted by membership churn or hangs; ``ElasticityIncompatibleWorldSize``
+raised when the elastic config rejects the current world size (the CLI
+maps it to exit ``3``; usage errors exit ``2``).
 """
 
 from __future__ import annotations
@@ -31,8 +53,11 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
 
 from ..launcher.launch import terminate_process_tree
+from ..resilience.retry import RetryPolicy, backoff_delay
 from ..utils.logging import logger
 from .elasticity import ElasticityIncompatibleWorldSize, compute_elastic_config
+
+HEARTBEAT_ENV = "DSTPU_ELASTIC_HEARTBEAT"
 
 
 @dataclass
@@ -61,6 +86,11 @@ class DSElasticAgent:
         static_world_size: Optional[int] = None,
         monitor_interval: float = 1.0,
         max_restarts: int = 3,
+        heartbeat_file: Optional[str] = None,
+        heartbeat_timeout: float = 0.0,
+        heartbeat_grace: Optional[float] = None,
+        restart_backoff: Optional[RetryPolicy | dict] = None,
+        backoff_seed: int = 0,
     ):
         if hostfile is None and static_world_size is None:
             raise ValueError("need a hostfile to watch or a static_world_size")
@@ -70,6 +100,28 @@ class DSElasticAgent:
         self.static_world_size = static_world_size
         self.monitor_interval = monitor_interval
         self.max_restarts = max_restarts
+        self.heartbeat_file = heartbeat_file
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        # until the worker's FIRST touch, the staleness clock is the startup
+        # grace, not the step timeout: time-to-first-step includes cold XLA
+        # compiles (minutes in this codebase), and a timeout sized from step
+        # cadence would SIGKILL a healthy compiling worker in a relaunch
+        # loop that re-pays the compile every generation
+        self.heartbeat_grace = (
+            float(heartbeat_grace) if heartbeat_grace is not None
+            else 10.0 * self.heartbeat_timeout)
+        self._hb_launch = 0.0
+        self._hb_created_mtime = 0.0
+        if isinstance(restart_backoff, dict):
+            restart_backoff = RetryPolicy(**restart_backoff)
+        # default: 1s doubling to 30s, +/-25% deterministic jitter — tight
+        # enough that a transient failure resumes fast, bounded so a
+        # crash-looping worker costs O(seconds) per generation, not a spin
+        self.restart_backoff = (
+            restart_backoff if restart_backoff is not None
+            else RetryPolicy(max_attempts=1 << 30, base_delay_s=1.0,
+                             max_delay_s=30.0, jitter=0.25))
+        self.backoff_seed = backoff_seed
         self.restart_count = 0
         self._proc: Optional[subprocess.Popen] = None
 
@@ -79,7 +131,14 @@ class DSElasticAgent:
             return int(self.static_world_size)
         from ..launcher.runner import fetch_hostfile
 
-        hosts = fetch_hostfile(self.hostfile)
+        try:
+            hosts = fetch_hostfile(self.hostfile)
+        except (OSError, ValueError):
+            # a poll can race a non-atomic hostfile rewrite: a missing file
+            # or a torn line ("host1 slots=") is an unreadable SNAPSHOT, not
+            # a membership verdict — report 0 and let callers keep the last
+            # good world (the same contract as the 0-hosts case below)
+            return 0
         return sum(hosts.values())
 
     # -- one generation ------------------------------------------------
@@ -99,11 +158,49 @@ class DSElasticAgent:
             DSTPU_ELASTIC_GENERATION=str(self.restart_count),
             **self.spec.extra_env,
         )
+        if self.heartbeat_file:
+            env[HEARTBEAT_ENV] = self.heartbeat_file
+            # fresh file per generation: the hung-worker clock starts at
+            # launch, not at the previous generation's last touch
+            with open(self.heartbeat_file, "w"):
+                pass
+            self._hb_launch = time.time()
+            # the creation mtime distinguishes "never touched yet" (startup
+            # grace applies) from "touched then went quiet" (step timeout)
+            self._hb_created_mtime = os.path.getmtime(self.heartbeat_file)
         logger.info(
             "elastic agent: launching generation %d at world=%d "
             "(batch=%d, micro=%d): %s",
             self.restart_count, world_size, final_batch, micro, argv)
         return subprocess.Popen(argv, env=env, start_new_session=True)
+
+    def _heartbeat_stale(self) -> bool:
+        """True when heartbeat monitoring is armed and the worker has not
+        touched the file within ``heartbeat_timeout`` seconds. A worker
+        that has never touched the file is still starting up (loading,
+        compiling) and gets ``heartbeat_grace`` instead — only after its
+        first touch does the step-cadence timeout apply."""
+        if not self.heartbeat_file or self.heartbeat_timeout <= 0:
+            return False
+        try:
+            mtime = os.path.getmtime(self.heartbeat_file)
+        except OSError:  # worker (or operator) deleted it: treat as stale
+            return True
+        if time.time() - mtime <= self.heartbeat_timeout:
+            return False
+        if mtime == self._hb_created_mtime:
+            return time.time() - self._hb_launch > self.heartbeat_grace
+        return True
+
+    def _backoff(self) -> None:
+        """Sleep the bounded-exponential delay for the upcoming restart
+        (generation number keys the deterministic jitter draw)."""
+        d = backoff_delay(max(1, self.restart_count), self.restart_backoff,
+                          seed=self.backoff_seed)
+        if d > 0:
+            logger.info("elastic agent: backing off %.2fs before restart %d",
+                        d, self.restart_count)
+            time.sleep(d)
 
     def _stop(self, sig=signal.SIGTERM):
         if self._proc is not None and self._proc.poll() is None:
@@ -120,6 +217,20 @@ class DSElasticAgent:
         exhausted (returns the last rc), or the world becomes infeasible
         (raises ElasticityIncompatibleWorldSize)."""
         world = self.current_world_size()
+        for _ in range(10):
+            if world > 0:
+                break
+            # startup can race the same non-atomic hostfile rewrite the
+            # poll loop tolerates: give the writer a grace window before
+            # declaring the hostfile genuinely unusable
+            logger.warning(
+                "elastic agent: hostfile %s unreadable/empty at startup; "
+                "retrying in %.1fs", self.hostfile, self.monitor_interval)
+            time.sleep(self.monitor_interval)
+            world = self.current_world_size()
+        if world <= 0:
+            raise ValueError(
+                f"elastic agent: no readable hosts in {self.hostfile}")
         self._proc = self._launch(world)
         generations = 1
         try:
@@ -138,12 +249,38 @@ class DSElasticAgent:
                     logger.warning(
                         "elastic agent: worker failed (rc=%d), restart %d/%d",
                         rc, self.restart_count, self.max_restarts)
-                    world = self.current_world_size()
+                    self._backoff()
+                    world = self.current_world_size() or world
+                    self._proc = self._launch(world)
+                    generations += 1
+                elif self._heartbeat_stale():
+                    # alive but wedged: the process neither exits nor
+                    # progresses (deadlocked collective, hung storage). It
+                    # already failed to die on its own — SIGKILL the tree.
+                    if self.restart_count >= self.max_restarts:
+                        logger.error(
+                            "elastic agent: worker heartbeat stale >%.1fs but "
+                            "restarts exhausted (%d); stopping",
+                            self.heartbeat_timeout, self.max_restarts)
+                        self._stop(signal.SIGKILL)
+                        return 1
+                    self.restart_count += 1
+                    logger.warning(
+                        "elastic agent: worker heartbeat stale >%.1fs — "
+                        "killing hung worker, restart %d/%d",
+                        self.heartbeat_timeout, self.restart_count,
+                        self.max_restarts)
+                    self._stop(signal.SIGKILL)
+                    self._backoff()
+                    world = self.current_world_size() or world
                     self._proc = self._launch(world)
                     generations += 1
                 else:
                     new_world = self.current_world_size()
-                    if new_world != world:
+                    # a membership poll can race a hostfile rewrite
+                    # (truncate-then-write is not atomic): 0 hosts is an
+                    # unreadable snapshot, not an eviction — skip this poll
+                    if new_world > 0 and new_world != world:
                         if self.restart_count >= self.max_restarts:
                             logger.error(
                                 "elastic agent: membership %d -> %d but restarts "
@@ -156,6 +293,7 @@ class DSElasticAgent:
                             world, new_world)
                         self._stop()
                         self.restart_count += 1
+                        self._backoff()
                         world = new_world
                         self._proc = self._launch(world)
                         generations += 1

@@ -8,6 +8,10 @@ Three pillars (docs/resilience.md):
                       test that passes only because recovery works;
   * ``guardrails``  — training-side NaN/overflow streak tracking with
                       skip → rewind → diverged escalation;
+  * ``preemption``  — SIGTERM/SIGINT → just-in-time checkpoint flag the
+                      engine consumes at the next step boundary;
+  * ``retry``       — shared bounded-exponential-backoff-with-jitter used
+                      around checkpoint I/O and elastic relaunches;
   * typed errors    — ``errors`` module; checkpoint integrity errors,
                       preemption, serving load-shed rejections.
 
@@ -24,6 +28,8 @@ from .errors import (
     RequestRejected,
     ResilienceError,
     TrainingDivergedError,
+    PermanentIOError,
+    TransientIOError,
 )
 from .faults import (
     FaultInjector,
@@ -33,19 +39,27 @@ from .faults import (
     maybe_io_error,
 )
 from .guardrails import TrainingGuardrail
+from .preemption import PreemptionGuard
+from .retry import RetryPolicy, backoff_delay, retry_call
 
 __all__ = [
     "CheckpointCorruptError",
     "CheckpointError",
     "CheckpointNotFoundError",
     "FaultInjector",
+    "PreemptionGuard",
     "PreemptionSignal",
     "RequestRejected",
     "ResilienceError",
+    "RetryPolicy",
     "TrainingDivergedError",
     "TrainingGuardrail",
+    "PermanentIOError",
+    "TransientIOError",
+    "backoff_delay",
     "clear_injector",
     "get_injector",
     "install_injector",
     "maybe_io_error",
+    "retry_call",
 ]

@@ -56,6 +56,25 @@ class PreemptionSignal(ResilienceError):
         self.step = step
 
 
+class TransientIOError(OSError):
+    """Injected *transient* I/O failure (the ``io_flaky`` fault site): the
+    same operation retried is expected to succeed. Deliberately an
+    ``OSError`` subclass — real transient storage errors arrive as plain
+    ``OSError``/``IOError``, so retry wrappers key on ``OSError`` and this
+    type exists only to make injected transience distinguishable in logs
+    and tests from the permanent ``io_error`` site."""
+
+
+class PermanentIOError(OSError):
+    """Injected *permanent* I/O failure (the ``io_error`` fault site):
+    models media/permission-class errors where retrying cannot help. An
+    ``OSError`` subclass so existing except clauses keep working — but the
+    engine's checkpoint retry wrapper explicitly refuses to retry it,
+    because the injector's write clock advances across attempts and a
+    blanket OSError retry would make the 'permanent' site quietly succeed
+    on attempt 2 (indistinguishable from ``io_flaky``)."""
+
+
 class RequestRejected(ResilienceError):
     """Serving load-shed verdict: the request was refused admission instead
     of growing the arrival queue without bound. ``reason`` is a stable typed

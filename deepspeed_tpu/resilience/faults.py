@@ -17,6 +17,13 @@ Fault sites (see docs/resilience.md for where each is wired):
   ``io_error``        ``OSError`` on the Nth guarded checkpoint/swap write
                       (checkpoint/saver.py consults the installed injector
                       before each file write).
+  ``io_flaky``        *transient* ``TransientIOError`` (an OSError) on the
+                      Nth guarded write — the write clock keeps advancing,
+                      so a retried save lands on fresh write numbers and
+                      succeeds; this is the site the retry wrapper
+                      (resilience/retry.py) exists to survive, while
+                      ``io_error`` models the permanent fault retries must
+                      NOT mask.
   ``garbage_logits``  NaN logits for a chosen request: the serving engine
                       poisons the request's slot KV so the next compiled
                       decode/prefill genuinely computes non-finite logits
@@ -57,7 +64,7 @@ class FaultInjector:
     ``runtime.config.FaultInjectionConfig``, a plain dict with the same
     keys, or None (disabled)."""
 
-    SITES = ("nan_grads", "io_error", "garbage_logits", "preempt")
+    SITES = ("nan_grads", "io_error", "io_flaky", "garbage_logits", "preempt")
 
     def __init__(self, cfg: Any = None):
         self.enabled = bool(_get(cfg, "enabled", False)) if cfg is not None else False
@@ -66,6 +73,7 @@ class FaultInjector:
         self.sites = set(_get(cfg, "sites", []) or [])
         self.nan_grad_steps = set(_get(cfg, "nan_grad_steps", []) or [])
         self.io_error_writes = set(_get(cfg, "io_error_writes", []) or [])
+        self.io_flaky_writes = set(_get(cfg, "io_flaky_writes", []) or [])
         self.garbage_logits_uids = set(_get(cfg, "garbage_logits_uids", []) or [])
         self.garbage_logits_phase = str(_get(cfg, "garbage_logits_phase", "decode"))
         self.garbage_logits_decode_step = int(_get(cfg, "garbage_logits_decode_step", 0))
@@ -114,16 +122,28 @@ class FaultInjector:
         return self._fire("nan_grads", step in self.nan_grad_steps, step)
 
     def io_error(self, path: str) -> None:
-        """Guarded-write hook: advances the write clock and raises ``OSError``
-        when this write is armed (listed index is 1-based)."""
+        """Guarded-write hook: advances the (shared) write clock and raises
+        ``OSError`` when this write is armed for the permanent ``io_error``
+        site, or ``TransientIOError`` for the ``io_flaky`` site (listed
+        indices are 1-based; a RETRY of a failed save advances the clock
+        past the armed index, which is what makes the flaky site
+        transient)."""
         if not self.enabled:
             return
         with self._lock:
             self._writes += 1
             n = self._writes
         if self._fire("io_error", n in self.io_error_writes, n):
-            raise OSError(
+            from .errors import PermanentIOError
+
+            raise PermanentIOError(
                 f"fault injection: io_error on guarded write #{n} ({path})")
+        if self._fire("io_flaky", n in self.io_flaky_writes, n):
+            from .errors import TransientIOError
+
+            raise TransientIOError(
+                f"fault injection: io_flaky (transient) on guarded write "
+                f"#{n} ({path})")
 
     def garbage_logits(self, uid: int, phase: str, decode_step: int = 0) -> bool:
         """True if request ``uid`` should produce NaN logits now. ``phase``

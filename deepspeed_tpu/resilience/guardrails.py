@@ -64,6 +64,27 @@ class TrainingGuardrail:
             return "rewind"
         return "diverged"
 
+    # -- checkpointable state (ridden by the engine's client_state) --------
+    # A preempted-and-resumed run must re-enter with the live streak, or a
+    # fault straddling the preemption would get a fresh skip budget (and a
+    # fresh rewind grant) the uninterrupted run never had. ``last_good``
+    # rides too: without it, a resumed run whose restored streak then
+    # crosses the threshold would find no rewind target and escalate to
+    # ``diverged`` where the uninterrupted run (whose guardrail still held
+    # its pre-streak good tag) would have rewound.
+    def state_dict(self) -> dict:
+        return {"bad_streak": self.bad_streak,
+                "rewinds_since_good": self._rewinds_since_good,
+                "last_good": list(self.last_good) if self.last_good else None}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.bad_streak = int(sd.get("bad_streak", self.bad_streak))
+        self._rewinds_since_good = int(
+            sd.get("rewinds_since_good", self._rewinds_since_good))
+        lg = sd.get("last_good")
+        if lg:  # absent/None (pre-PR-5 checkpoints) keeps the live value
+            self.last_good = (str(lg[0]), str(lg[1]))
+
     def rewound(self) -> None:
         """The engine completed a rewind: the streak restarts from clean.
         A second rewind is not granted until a finite step lands — if the

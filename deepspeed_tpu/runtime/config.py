@@ -241,14 +241,19 @@ class FaultInjectionConfig:
 
     - ``nan_grad_steps``: 1-based global steps whose gradients go non-finite.
     - ``io_error_writes``: 1-based indices of guarded checkpoint file writes
-      that raise ``OSError``.
+      that raise ``OSError`` (permanent — retries must NOT mask it).
+    - ``io_flaky_writes``: 1-based indices of guarded writes that raise a
+      *transient* ``TransientIOError`` — the write clock advances across
+      retries, so a retried save succeeds (the ``resilience.retry`` proof
+      site).
     - ``garbage_logits_uids`` (+ ``garbage_logits_phase`` ``prefill|decode``,
       ``garbage_logits_decode_step`` 0-based): serving requests whose slot KV
       is poisoned so the compiled program genuinely computes NaN logits.
     - ``preempt_steps``: 1-based global steps before which a
       ``PreemptionSignal`` is raised (pre-dispatch: state is checkpointable).
     - ``rate`` in [0, 1] with optional ``sites`` allowlist
-      (``nan_grads`` | ``io_error`` | ``garbage_logits`` | ``preempt``).
+      (``nan_grads`` | ``io_error`` | ``io_flaky`` | ``garbage_logits`` |
+      ``preempt``).
     """
 
     enabled: bool = False
@@ -257,6 +262,7 @@ class FaultInjectionConfig:
     sites: list = field(default_factory=list)
     nan_grad_steps: list = field(default_factory=list)
     io_error_writes: list = field(default_factory=list)
+    io_flaky_writes: list = field(default_factory=list)
     garbage_logits_uids: list = field(default_factory=list)
     garbage_logits_phase: str = "decode"
     garbage_logits_decode_step: int = 0
@@ -270,10 +276,84 @@ class FaultInjectionConfig:
             raise DeepSpeedConfigError(
                 "fault_injection.garbage_logits_phase must be prefill|decode, "
                 f"got {self.garbage_logits_phase!r}")
-        bad = set(self.sites) - {"nan_grads", "io_error", "garbage_logits", "preempt"}
+        bad = set(self.sites) - {"nan_grads", "io_error", "io_flaky",
+                                 "garbage_logits", "preempt"}
         if bad:
             raise DeepSpeedConfigError(
                 f"fault_injection.sites contains unknown site(s) {sorted(bad)}")
+
+
+@dataclass
+class PreemptionConfig:
+    """``resilience.preemption`` block (consumed by ``runtime/engine.py`` +
+    ``resilience/preemption.PreemptionGuard``; docs/resilience.md).
+
+    - ``enabled``: install SIGTERM/SIGINT handlers at engine init; the flag
+      is consumed at the next step boundary, where the engine takes a
+      just-in-time atomic checkpoint and raises ``PreemptionSignal`` —
+      the same code path the fault injector's ``preempt`` site drives.
+    - ``save_dir``: where the JIT checkpoint lands (with a durable 'latest'
+      repoint). Empty = no JIT checkpoint; the signal still surfaces as
+      ``PreemptionSignal`` and the caller owns saving (the pre-PR 5
+      behavior).
+    - ``tag``: the JIT checkpoint's tag (re-saved over on every preemption;
+      the atomic re-save-over-tag protocol keeps every crash window safe).
+    - ``signals``: handler set, by name.
+    """
+
+    enabled: bool = False
+    save_dir: str = ""
+    tag: str = "preempt"
+    signals: list = field(default_factory=lambda: ["SIGTERM", "SIGINT"])
+
+    def __post_init__(self):
+        import signal as _signal
+
+        if not self.tag or "/" in self.tag:
+            raise DeepSpeedConfigError(
+                f"resilience.preemption.tag must be a plain tag name, got "
+                f"{self.tag!r}")
+        for name in self.signals:
+            if not isinstance(name, str) or not name.startswith("SIG"):
+                raise DeepSpeedConfigError(
+                    f"resilience.preemption.signals entries must be signal "
+                    f"names like 'SIGTERM', got {name!r}")
+            if not hasattr(_signal, name):
+                raise DeepSpeedConfigError(
+                    f"resilience.preemption.signals: unknown signal {name!r}")
+            if name in ("SIGKILL", "SIGSTOP"):
+                # uncatchable by POSIX — signal.signal() would raise OSError
+                # at engine init, long after this config was accepted
+                raise DeepSpeedConfigError(
+                    f"resilience.preemption.signals: {name} cannot be "
+                    "caught; a handler can never run for it")
+
+
+@dataclass
+class RetryConfig:
+    """``resilience.retry`` block (consumed by ``resilience/retry.py``
+    wrappers around checkpoint I/O; the elastic agent reuses the same
+    backoff math for relaunch spacing; docs/resilience.md).
+
+    ``max_attempts`` bounds total tries (1 = no retries); delays grow
+    ``base_delay_s * 2**(attempt-1)`` capped at ``max_delay_s``, spread by
+    +/- ``jitter`` with a deterministic seeded draw."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.5
+    max_delay_s: float = 8.0
+    jitter: float = 0.25
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise DeepSpeedConfigError(
+                f"resilience.retry.max_attempts must be >= 1, got "
+                f"{self.max_attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise DeepSpeedConfigError("resilience.retry delays must be >= 0")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise DeepSpeedConfigError(
+                f"resilience.retry.jitter must be in [0, 1], got {self.jitter}")
 
 
 @dataclass
@@ -294,15 +374,25 @@ class ResilienceConfig:
       the streak threshold is hit. Data-loader replay after a rewind is the
       caller's responsibility (the engine restores model/optimizer state and
       the step clock).
+    - ``preemption``: signal-driven just-in-time checkpoints (its own
+      dataclass above).
+    - ``retry``: bounded-backoff policy wrapped around checkpoint saves
+      (transient storage errors survive; permanent ones still surface).
     - ``fault_injection``: deterministic fault source for tests/CI smoke.
     """
 
     enabled: bool = False
     max_consecutive_bad_steps: int = 3
     rewind: bool = True
+    preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
+    retry: RetryConfig = field(default_factory=RetryConfig)
     fault_injection: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
 
     def __post_init__(self):
+        if isinstance(self.preemption, dict):
+            self.preemption = _build(PreemptionConfig, self.preemption)
+        if isinstance(self.retry, dict):
+            self.retry = _build(RetryConfig, self.retry)
         if isinstance(self.fault_injection, dict):
             self.fault_injection = _build(FaultInjectionConfig, self.fault_injection)
         if self.max_consecutive_bad_steps < 1:
@@ -579,6 +669,7 @@ class DeepSpeedConfig:
     train_micro_batch_size_per_gpu: Optional[int] = None
     gradient_accumulation_steps: Optional[int] = None
     steps_per_print: int = C.STEPS_PER_PRINT_DEFAULT
+    seed: int = C.SEED_DEFAULT
     gradient_clipping: float = C.GRADIENT_CLIPPING_DEFAULT
     prescale_gradients: bool = False
     gradient_predivide_factor: float = 1.0
@@ -627,6 +718,7 @@ class DeepSpeedConfig:
             train_micro_batch_size_per_gpu=d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU),
             gradient_accumulation_steps=d.get(C.GRADIENT_ACCUMULATION_STEPS),
             steps_per_print=d.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT),
+            seed=int(d.get(C.SEED, C.SEED_DEFAULT)),
             gradient_clipping=d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT),
             prescale_gradients=d.get(C.PRESCALE_GRADIENTS, False),
             gradient_predivide_factor=d.get(C.GRADIENT_PREDIVIDE_FACTOR, 1.0),

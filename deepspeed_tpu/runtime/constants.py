@@ -24,6 +24,9 @@ ZERO_OPTIMIZATION = "zero_optimization"
 STEPS_PER_PRINT = "steps_per_print"
 STEPS_PER_PRINT_DEFAULT = 10
 
+SEED = "seed"
+SEED_DEFAULT = 0
+
 WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
 MEMORY_BREAKDOWN = "memory_breakdown"
 

@@ -79,3 +79,17 @@ class CurriculumScheduler:
 
     def get_current_difficulty(self) -> int:
         return self.current_difficulty
+
+    # -- checkpointable state (docs/resilience.md "elastic resume") --------
+    # The schedule itself is a pure function of global_steps, but the LIVE
+    # difficulty is what the engine's seqlen-truncation hook applies on the
+    # next batch — a resumed run must re-enter at the same difficulty, not
+    # at min_difficulty for one step.
+    def state_dict(self) -> dict:
+        return {"current_difficulty": self.current_difficulty,
+                "first_step": self.first_step}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.current_difficulty = int(
+            sd.get("current_difficulty", self.current_difficulty))
+        self.first_step = bool(sd.get("first_step", self.first_step))

@@ -108,6 +108,7 @@ class DeepSpeedDataLoader:
     ):
         self.dataset = dataset
         self.batch_size = batch_size
+        self.num_replicas = num_replicas
         self.sampler = DistributedSampler(
             len(dataset), num_replicas, rank, shuffle=shuffle, seed=seed, drop_last=drop_last
         )
@@ -115,23 +116,102 @@ class DeepSpeedDataLoader:
         self._len = len(self.sampler) // batch_size if drop_last else math.ceil(
             len(self.sampler) / batch_size
         )
+        self.batches_yielded = 0  # within the current epoch
+        self._resume_skip = 0  # batches to fast-forward on the next __iter__
 
     def set_epoch(self, epoch: int) -> None:
+        if int(epoch) != self.sampler.epoch:
+            # a NEW epoch voids any pending resume skip; re-announcing the
+            # current epoch (the canonical `loader.set_epoch(e)` at the top
+            # of the epoch loop, re-run after a mid-epoch resume) must NOT —
+            # the restored cursor would silently replay the epoch from 0
+            self._resume_skip = 0
+            self.batches_yielded = 0
         self.sampler.set_epoch(epoch)
 
     def __len__(self):
         return self._len
 
+    # -- checkpointable cursor (docs/resilience.md "elastic resume") -------
+    def state_dict(self) -> dict:
+        """The data cursor a resumed run needs to continue mid-epoch
+        without re-reading or skipping samples. ``batches_yielded`` counts
+        batches HANDED OUT — a batch fetched but not yet trained when a
+        preemption fires must be replayed, which is why the engine
+        checkpoints the cursor it snapshotted at the last *completed* step,
+        not this live count. ``global_samples`` (samples consumed this
+        epoch across ALL replicas) is the topology-free form: a resume on
+        a different dp world rescales through it."""
+        return {
+            "epoch": self.sampler.epoch,
+            "batches_yielded": self.batches_yielded,
+            "batch_size": self.batch_size,
+            "num_replicas": self.num_replicas,
+            "sampler_seed": self.sampler.seed,
+            "shuffle": self.sampler.shuffle,
+            "global_samples": self.batches_yielded * self.batch_size * self.num_replicas,
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Restore the cursor; the next ``__iter__`` fast-forwards to it.
+        Same batch geometry resumes at the exact batch index; a changed
+        geometry (elastic dp resize — ``compute_elastic_config`` picked a
+        new micro-batch, so per-process ``batch_size * num_replicas``
+        moved) converts through the epoch's global sample count, so the
+        resumed run consumes each remaining sample exactly once."""
+        self.sampler.set_epoch(int(sd.get("epoch", 0)))
+        if int(sd.get("sampler_seed", self.sampler.seed)) != self.sampler.seed:
+            raise ValueError(
+                "dataloader.load_state_dict: sampler seed mismatch "
+                f"({sd.get('sampler_seed')} saved vs {self.sampler.seed} "
+                "live) — the shuffled sample order would silently diverge")
+        if bool(sd.get("shuffle", self.sampler.shuffle)) != self.sampler.shuffle:
+            raise ValueError(
+                "dataloader.load_state_dict: shuffle mismatch "
+                f"({sd.get('shuffle')} saved vs {self.sampler.shuffle} live) "
+                "— the sample order would silently diverge")
+        here = self.batch_size * self.num_replicas
+        saved = int(sd.get("batch_size", self.batch_size)) * int(
+            sd.get("num_replicas", self.num_replicas))
+        if saved == here:
+            skip = int(sd.get("batches_yielded", 0))
+        else:
+            global_samples = int(sd.get(
+                "global_samples", int(sd.get("batches_yielded", 0)) * saved))
+            skip, rem = divmod(global_samples, here)
+            if rem:
+                # the old geometry's boundary falls inside a new global
+                # batch: replay the partial batch (never skip samples)
+                import warnings
+
+                warnings.warn(
+                    f"dataloader cursor rescale: {global_samples} consumed "
+                    f"samples is not a multiple of the new global batch "
+                    f"{here}; {rem} samples of the boundary batch are "
+                    "replayed", stacklevel=2)
+        self._resume_skip = min(skip, self._len)
+        self.batches_yielded = self._resume_skip
+
     def __iter__(self):
+        skip, self._resume_skip = self._resume_skip, 0
+        self.batches_yielded = skip
         batch: list[Any] = []
         emitted = 0
+        to_skip = skip * self.batch_size  # indices, not materialized samples
         for i in self.sampler:
+            if to_skip > 0:
+                to_skip -= 1
+                continue
             batch.append(self.dataset[i])
             if len(batch) == self.batch_size:
-                yield self.collate_fn(batch)
+                # count BEFORE yielding: a batch handed to the caller is
+                # consumed (the engine trains on it before any checkpoint)
                 emitted += 1
+                self.batches_yielded = skip + emitted
+                yield self.collate_fn(batch)
                 batch = []
-        if batch and emitted < self._len:
+        if batch and skip + emitted < self._len:
+            self.batches_yielded = skip + emitted + 1
             yield self.collate_fn(batch)
 
 

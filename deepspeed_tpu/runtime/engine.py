@@ -198,6 +198,46 @@ class DeepSpeedEngine:
                 f"{rcfg.max_consecutive_bad_steps} consecutive bad steps; "
                 "one overflow fetch per step)", ranks=[0])
         self._injected_scale: float | None = None  # nan_grads restore value
+        # signal-driven preemption: the guard's flag is consumed at the next
+        # step boundary (_resilience_pre_step), converging with the
+        # injector's preempt site on ONE code path (_preempt): JIT atomic
+        # checkpoint (when save_dir is configured) then PreemptionSignal
+        self._preemption_guard = None
+        from ..resilience.preemption import (
+            PreemptionGuard,
+            activate_guard,
+            reap_orphaned_guard,
+        )
+
+        if rcfg.preemption.enabled:
+            self._preemption_guard = PreemptionGuard(rcfg.preemption.signals)
+            # the process-global slot: claiming it evicts a discarded
+            # predecessor's handlers (which would otherwise swallow
+            # SIGTERM/SIGINT with a flag nothing consumes)
+            live = activate_guard(self._preemption_guard, owner=self)
+            log_dist(
+                "resilience: preemption guard armed "
+                f"({'+'.join(rcfg.preemption.signals)}"
+                f"{'' if live else ' — trigger()-only, handlers unavailable'}"
+                + (f"; JIT checkpoint -> {rcfg.preemption.save_dir}"
+                   if rcfg.preemption.save_dir else "; no save_dir: caller saves")
+                + ")", ranks=[0])
+        else:
+            # a preemption-disabled engine evicts a DISCARDED predecessor's
+            # orphaned guard only — a live sibling's (train engine next to
+            # an eval engine) stays armed
+            reap_orphaned_guard()
+        # per-step stochastics (dropout/PLD) derive from fold_in(PRNGKey(seed),
+        # step): the config's top-level `seed` rides the checkpoint client
+        # state so a resumed run replays the exact dropout masks of the
+        # uninterrupted one — even when the resuming config forgot to set it
+        # (restore detects the mismatch and rebuilds the compiled step).
+        # Default 0 keeps the traced constant — and therefore the compiled
+        # program — identical to pre-seed builds.
+        self._stochastics_seed = int(self.config.seed)
+        self.training_dataloader = None  # set by deepspeed_io/set_dataloader
+        self._dl_cursor = None  # loader cursor at the last COMPLETED step
+        self._pending_dl_state = None  # cursor loaded before a loader exists
 
         self._acknowledge_compiler_managed_knobs(raw)
         self._enforce_elasticity(raw)
@@ -1185,6 +1225,7 @@ class DeepSpeedEngine:
         micro_grad = self._make_micro_grad(compute_dtype)
 
         dropout = self._dropout_enabled
+        rng_seed = self._stochastics_seed
 
         # offload_param: gradients come back PINNED TO HOST (the model's
         # stream_to_device vjp) — every full-tree gradient op (accumulate,
@@ -1222,9 +1263,11 @@ class DeepSpeedEngine:
                 return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
 
             batch_g = jax.tree.map(reshape_leaf, batch)
-            # per-micro dropout keys, deterministic in the global step
+            # per-micro dropout keys, deterministic in (engine seed, global
+            # step) — the seed rides the checkpoint, so a resumed run's
+            # dropout masks bitwise-match the uninterrupted run's
             micro_rngs = jax.random.split(
-                jax.random.fold_in(jax.random.PRNGKey(0), state["step"] + 1), gas
+                jax.random.fold_in(jax.random.PRNGKey(rng_seed), state["step"] + 1), gas
             )
 
             def constrain_mb(mb):
@@ -1547,24 +1590,28 @@ class DeepSpeedEngine:
             )
         self._train_telemetry(batch, metrics if need_host else None, _sp.dur_s)
         self._resilience_post_step(metrics)
+        self._snapshot_dl_cursor()
         return metrics
 
     # ------------------------------------------------------------------
     # Resilience hooks (resilience/; docs/resilience.md)
     # ------------------------------------------------------------------
     def _resilience_pre_step(self) -> None:
-        """Fault-injection sites that fire BEFORE a step is dispatched:
-        simulated preemption (state is the consistent post-previous-step
-        state — checkpoint and exit), and the nan_grads site."""
+        """Pre-dispatch resilience gates: a pending REAL preemption signal
+        (PreemptionGuard flag, set from SIGTERM/SIGINT or the trigger()
+        test hook), then the fault-injection sites — simulated preemption
+        (state is the consistent post-previous-step state — checkpoint and
+        exit) and nan_grads. Both preemption sources funnel into
+        ``_preempt``."""
+        step1 = self.global_steps + 1
+        guard = self._preemption_guard
+        if guard is not None and guard.consume():
+            self._preempt(source="signal")
         inj = self.fault_injector
         if inj is None:
             return
-        step1 = self.global_steps + 1
         if inj.preempt(step1):
-            from ..resilience import PreemptionSignal
-
-            self.telemetry.counter("resilience/preemptions").inc()
-            raise PreemptionSignal(step=self.global_steps)
+            self._preempt(source="injected")
         if inj.nan_grads(step1):
             # transient poison: a non-finite loss scale makes the step's
             # loss/gradients genuinely non-finite INSIDE the compiled program
@@ -1576,6 +1623,50 @@ class DeepSpeedEngine:
                 jnp.asarray(float("inf"), jnp.float32),
                 self._state_shardings["loss_scale"])
             self.telemetry.counter("resilience/injected_nan_steps").inc()
+
+    def _snapshot_dl_cursor(self) -> None:
+        """Record the attached loader's cursor at the end of a COMPLETED
+        step. In the canonical loop (``for b in loader: train_batch(b)``)
+        the iterator is exactly one fetch ahead while a preemption is in
+        flight — checkpointing this snapshot instead of the live fetch
+        count makes the preempted batch replay on resume."""
+        dl = self.training_dataloader
+        if dl is not None and hasattr(dl, "state_dict"):
+            self._dl_cursor = dl.state_dict()
+
+    def _preempt(self, source: str) -> None:
+        """THE preemption path — real signal and injected drill alike. At a
+        step boundary the state is checkpoint-consistent: take a
+        just-in-time atomic checkpoint under the dedicated ``preempt`` tag
+        (durable 'latest' repoint included — the relauncher just loads
+        'latest'), then raise ``PreemptionSignal`` for the supervisor.
+        Without a configured ``save_dir`` the signal still surfaces and the
+        caller owns saving (the pre-elastic behavior)."""
+        from ..resilience import PreemptionSignal
+
+        self.telemetry.counter("resilience/preemptions").inc()
+        pcfg = self.config.resilience.preemption
+        if pcfg.save_dir:
+            t0 = time.perf_counter()
+            self.save_checkpoint(pcfg.save_dir, tag=pcfg.tag)
+            # a preempted process is about to die: an async save must be
+            # durable BEFORE the signal propagates, or the relaunch loads
+            # the previous 'latest'
+            self.checkpoint_engine.commit()
+            dt = time.perf_counter() - t0
+            self.telemetry.histogram("resilience/jit_ckpt_sec").observe(dt)
+            self.telemetry.counter("resilience/jit_checkpoints").inc()
+            log_dist(
+                f"resilience: preemption ({source}) at step "
+                f"{self.global_steps} — JIT checkpoint "
+                f"{pcfg.save_dir}/{pcfg.tag} committed in {dt:.2f}s",
+                ranks=[0])
+        else:
+            log_dist(
+                f"resilience: preemption ({source}) at step "
+                f"{self.global_steps} — no preemption.save_dir, caller must "
+                "save", ranks=[0])
+        raise PreemptionSignal(step=self.global_steps)
 
     def _resilience_post_step(self, metrics, overflow: bool | None = None) -> None:
         """Restore an injected loss scale; when the guardrail is armed,
@@ -1597,7 +1688,11 @@ class DeepSpeedEngine:
             logger.warning(
                 "resilience: %d consecutive non-finite steps — rewinding to "
                 "checkpoint %s/%s", self._guardrail.bad_streak, d, t)
-            self.load_checkpoint(d, t)
+            # _restore_dataloader=False: docs promise "data-loader replay
+            # after a rewind is the caller's responsibility" — restoring
+            # the saved cursor here would arm a _resume_skip that silently
+            # fast-forwards the caller's next pass over the SAME epoch
+            self.load_checkpoint(d, t, _restore_dataloader=False)
             self._guardrail.rewound()
         elif action == "diverged":
             from ..resilience import TrainingDivergedError
@@ -1732,6 +1827,7 @@ class DeepSpeedEngine:
         # already on host, so the gauges update every step
         self._train_telemetry(batch, metrics, time.perf_counter() - t_step)
         self._resilience_post_step(metrics, overflow=overflow)
+        self._snapshot_dl_cursor()
         return metrics
 
     def _maybe_quantize_weights(self):
@@ -1794,7 +1890,7 @@ class DeepSpeedEngine:
                 f"{n_proc} processes"
             )
             batch_size = self.train_batch_size // n_proc
-        return DeepSpeedDataLoader(
+        loader = DeepSpeedDataLoader(
             dataset,
             batch_size=batch_size,
             num_replicas=n_proc,
@@ -1802,6 +1898,31 @@ class DeepSpeedEngine:
             drop_last=self.config.dataloader_drop_last,
             **kw,
         )
+        # attach (FIRST loader only — a later deepspeed_io(val_ds) for eval
+        # must not clobber the training cursor; set_dataloader reassigns
+        # explicitly): save_checkpoint captures the loader's cursor and
+        # load_checkpoint restores (and dp-rescales) it automatically
+        if self.training_dataloader is None:
+            self.set_dataloader(loader)
+        return loader
+
+    def set_dataloader(self, loader) -> None:
+        """Attach a loader as THE training dataloader whose ``state_dict()``
+        cursor rides checkpoints (``deepspeed_io`` attaches its first loader
+        automatically; later ones — eval/validation — are left detached). A
+        cursor restored by a load_checkpoint that ran BEFORE the loader
+        existed (the natural relaunch order: build engine -> load -> build
+        loader -> train) is applied now instead of being silently lost.
+        The cursor snapshot starts at the attach-time position: a batch
+        fetched before the first completed step must REPLAY if a preemption
+        fires during step 1, so the live (already-advanced) count is never
+        what a checkpoint records."""
+        self.training_dataloader = loader
+        if self._pending_dl_state is not None and hasattr(loader, "load_state_dict"):
+            loader.load_state_dict(self._pending_dl_state)
+            self._pending_dl_state = None
+        self._dl_cursor = (loader.state_dict()
+                          if hasattr(loader, "state_dict") else None)
 
     def _report_progress(self, metrics):
         log_dist(
@@ -1838,6 +1959,7 @@ class DeepSpeedEngine:
         self._eval_fn = self._loss_eval
 
         dropout = self._dropout_enabled
+        rng_seed = self._stochastics_seed
 
         def grad_of(state, batch):
             def f(params):
@@ -1845,7 +1967,7 @@ class DeepSpeedEngine:
                     lambda p: p.astype(compute_dtype) if p.dtype == jnp.float32 else p, params
                 )
                 if dropout:
-                    rng = jax.random.fold_in(jax.random.PRNGKey(0), state["step"] + 1)
+                    rng = jax.random.fold_in(jax.random.PRNGKey(rng_seed), state["step"] + 1)
                     return model.loss(cast, batch, rng=rng, step=state["step"] + 1) * state["loss_scale"]
                 return model.loss(cast, batch) * state["loss_scale"]
 
@@ -1981,15 +2103,67 @@ class DeepSpeedEngine:
             global_steps=self.global_steps,
             global_samples=self.global_samples,
             skipped_steps=self.skipped_steps,
+            # full training-state capture (docs/resilience.md "elastic
+            # resume"): everything host-side that shapes the forward
+            # trajectory rides the manifest, so train-k / preempt /
+            # resume / train-(n-k) is bitwise train-n — dropout included
+            rng_seed=self._stochastics_seed,
+            dp_world=self.dp_world,
+            micro_batch_size=self.micro_batch_size,
+            train_batch_size=self.train_batch_size,
         )
+        dl = self.training_dataloader
+        if dl is not None and self._dl_cursor is not None:
+            # the cursor snapshotted at the last COMPLETED step (attach-time
+            # position before step 1), never the live fetch count: a batch
+            # handed out by the iterator but preempted before dispatch must
+            # be REPLAYED on resume
+            extra["dataloader"] = dict(self._dl_cursor)
+        if self.curriculum_scheduler is not None:
+            extra["curriculum"] = self.curriculum_scheduler.state_dict()
+        if self._guardrail is not None:
+            extra["guardrail"] = self._guardrail.state_dict()
         eng = self.checkpoint_engine
-        eng.save(
-            os.path.join(save_dir, tag),
-            self.state,
-            client_state=extra,
-            async_save=self._ckpt_async,
-            latest=(os.path.join(save_dir, "latest"), tag),
-        )
+        rcfg = self.config.resilience
+
+        def _do_save():
+            return eng.save(
+                os.path.join(save_dir, tag),
+                self.state,
+                client_state=extra,
+                async_save=self._ckpt_async,
+                latest=(os.path.join(save_dir, "latest"), tag),
+            )
+
+        if rcfg.enabled and not self._ckpt_async:
+            # transient storage errors (the io_flaky site in tests; blips on
+            # real network filesystems) retry under bounded backoff; a failed
+            # attempt's staging leftovers are reclaimed by the next attempt,
+            # so retrying an atomic save is itself atomic. Permanent
+            # failures exhaust the budget and surface unchanged. (Async
+            # saves surface errors at commit() on the caller's thread —
+            # retrying there would re-snapshot drifted state, so they are
+            # not wrapped.)
+            from ..resilience.retry import retry_call
+
+            def _note_retry(attempt, exc, delay):
+                self.telemetry.counter("resilience/ckpt_retries").inc()
+                logger.warning(
+                    "checkpoint save %s/%s attempt %d failed (%s); retrying "
+                    "in %.2fs", save_dir, tag, attempt, exc, delay)
+
+            from ..resilience import PermanentIOError
+
+            # fold the process index into the jitter seed: a shared-storage
+            # blip fails EVERY rank's write in the same window, and
+            # identically-seeded backoff would re-hit the recovering
+            # filesystem in a synchronized retry storm
+            retry_call(_do_save, policy=rcfg.retry, retry_on=(OSError,),
+                       no_retry_on=(PermanentIOError,),
+                       seed=rcfg.fault_injection.seed + jax.process_index(),
+                       on_retry=_note_retry)
+        else:
+            _do_save()
         if self._nvme_offload and jax.process_index() == 0:
             # the tier's masters/moments live on NVMe, outside self.state —
             # persist them too (the reference's ZeRO-Infinity checkpoints
@@ -2055,6 +2229,45 @@ class DeepSpeedEngine:
         load_checkpoint by another name, kept for API parity."""
         return self.load_checkpoint(load_dir, tag=tag)
 
+    def _restore_training_state(self, client_state: dict,
+                                restore_dataloader: bool = True) -> None:
+        """Re-hydrate the host-side trajectory state the client_state
+        captured at save (docs/resilience.md "elastic resume"): stochastics
+        seed (dropout masks), data-iterator cursor (dp-rescaled when the
+        mesh changed; skipped on a guardrail rewind, where data replay is
+        the caller's documented responsibility), curriculum difficulty, and
+        guardrail streak. Checkpoints predating these keys restore what
+        they carry."""
+        seed = int(client_state.get("rng_seed", self._stochastics_seed))
+        if seed != self._stochastics_seed:
+            # the seed is a trace-time constant: rebuild the compiled step
+            # and the compat fns so the restored masks actually apply
+            self._stochastics_seed = seed
+            self._train_step = None
+            self._grad_fn = self._apply_fn = self._eval_fn = None
+        saved_dp = int(client_state.get("dp_world", self.dp_world) or self.dp_world)
+        if saved_dp != self.dp_world:
+            self.telemetry.counter("resilience/topology_changes").inc()
+            log_dist(
+                f"elastic resume: checkpoint saved at dp={saved_dp} "
+                f"(micro={client_state.get('micro_batch_size', '?')}), live "
+                f"mesh dp={self.dp_world} (micro={self.micro_batch_size}) — "
+                "arrays resharded to the live mesh; data cursor rescales "
+                "through the global sample count", ranks=[0])
+        if restore_dataloader and "dataloader" in client_state:
+            dl = self.training_dataloader
+            if dl is not None and hasattr(dl, "load_state_dict"):
+                dl.load_state_dict(client_state["dataloader"])
+                self._dl_cursor = dl.state_dict()
+            else:
+                # no loader attached yet (load-before-deepspeed_io relaunch
+                # order): stash the cursor; set_dataloader applies it
+                self._pending_dl_state = dict(client_state["dataloader"])
+        if self.curriculum_scheduler is not None and "curriculum" in client_state:
+            self.curriculum_scheduler.load_state_dict(client_state["curriculum"])
+        if self._guardrail is not None and "guardrail" in client_state:
+            self._guardrail.load_state_dict(client_state["guardrail"])
+
     def _zero3_consolidated_16bit_state_dict(self) -> dict:
         """Full (unsharded) compute-dtype weights as a flat path->array dict
         (reference runtime/engine.py:3194): every ZeRO-3 shard gathered to
@@ -2106,7 +2319,8 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         fallback_to_intact: bool = True,
-                        verify: Optional[bool] = None):
+                        verify: Optional[bool] = None,
+                        _restore_dataloader: bool = True):
         """Restore engine state from ``load_dir``. With ``tag=None`` the
         'latest' tag is followed; if that checkpoint fails integrity
         verification (``CheckpointCorruptError`` — torn write, digest
@@ -2124,6 +2338,7 @@ class DeepSpeedEngine:
 
         if verify is None:
             verify = self.config.checkpoint.verify_integrity
+        t_load = time.perf_counter()
         explicit = tag is not None
         if tag is None:
             latest = os.path.join(load_dir, "latest")
@@ -2180,6 +2395,13 @@ class DeepSpeedEngine:
         self.state = state
         self.global_steps = client_state.get("global_steps", int(jax.device_get(state["step"])))
         self.global_samples = client_state.get("global_samples", 0)
+        self._restore_training_state(
+            client_state, restore_dataloader=_restore_dataloader)
+        # the load IS the reshard: make_array_from_callback pulled exactly
+        # the slices the LIVE mesh needs from the saved global shapes
+        self.telemetry.histogram("resilience/reshard_sec").observe(
+            time.perf_counter() - t_load)
+        self.telemetry.counter("resilience/resumes").inc()
         if self._onebit_cfg is not None:
             # host-side phase clock mirrors the device's applied-step counter
             self._onebit_applied_steps = int(jax.device_get(state["step"]))

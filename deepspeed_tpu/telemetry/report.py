@@ -161,11 +161,22 @@ def summarize(events: list[dict], top: int = 10) -> str:
             if name.startswith("resilience/"):
                 res_counters[name.split("/", 1)[1]] = v
     fi = snap.get("fault_injection") if snap is not None else None
-    if res_counters or fi:
+    res_hists = {}
+    if snap is not None:
+        for name, h in snap.get("metrics", {}).get("histograms", {}).items():
+            if name.startswith("resilience/"):
+                res_hists[name.split("/", 1)[1]] = h
+    if res_counters or fi or res_hists:
         lines.append("resilience:")
         if res_counters:
             lines.append("  " + " ".join(
                 f"{k}={v:g}" for k, v in sorted(res_counters.items())))
+        for name, h in sorted(res_hists.items()):
+            # jit_ckpt_sec (preemption checkpoint latency) / reshard_sec
+            # (resume load+reshard) — the elastic loop's two wall-clock costs
+            lines.append(
+                f"  {name}: n={h['count']} p50={_fmt_s(h['p50'])} "
+                f"p90={_fmt_s(h['p90'])} p99={_fmt_s(h['p99'])}")
         if fi:
             inj = fi.get("injected", {})
             opp = fi.get("opportunities", {})

@@ -49,11 +49,13 @@ def test_dropout_inverted_scaling_preserves_mean():
     params = tfm.init(cfg, jax.random.PRNGKey(0))
     toks = jnp.asarray(np.random.default_rng(0).integers(0, 128, size=(1, 9)), jnp.int32)
     ref = np.asarray(tfm.apply(cfg, params, toks))
+    # 32 keys (was 64): the estimator's noise grows ~sqrt(2)x, covered by
+    # the widened tolerance — halves this test's share of the tier-1 budget
     outs = np.stack([
         np.asarray(tfm.apply(cfg, params, toks, rng=jax.random.PRNGKey(i)))
-        for i in range(64)
+        for i in range(32)
     ])
-    np.testing.assert_allclose(outs.mean(0), ref, rtol=0.35, atol=0.1)
+    np.testing.assert_allclose(outs.mean(0), ref, rtol=0.5, atol=0.14)
 
 
 def test_dropout_training_loss_differs_and_trains():

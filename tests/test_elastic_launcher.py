@@ -37,6 +37,10 @@ ELASTIC_CFG = {
     }
 }
 
+# restart pacing is real-time sleep: zero it in tests (the backoff MATH has
+# its own deterministic tests below)
+NO_BACKOFF = {"base_delay_s": 0.0, "max_delay_s": 0.0, "jitter": 0.0}
+
 
 # ---------------------------------------------------------------- agent
 def test_agent_clean_exit(tmp_path):
@@ -45,6 +49,7 @@ def test_agent_clean_exit(tmp_path):
         WorkerSpec(command=[sys.executable, "-c", "print('ok')"]),
         static_world_size=4,
         monitor_interval=0.05,
+        restart_backoff=NO_BACKOFF,
     )
     assert agent.run() == 0
     assert agent.restart_count == 0
@@ -67,6 +72,7 @@ def test_agent_restarts_failed_worker(tmp_path):
         static_world_size=4,
         monitor_interval=0.05,
         max_restarts=5,
+        restart_backoff=NO_BACKOFF,
     )
     assert agent.run() == 0
     assert agent.restart_count == 2
@@ -80,6 +86,7 @@ def test_agent_exhausts_restarts():
         static_world_size=4,
         monitor_interval=0.05,
         max_restarts=1,
+        restart_backoff=NO_BACKOFF,
     )
     assert agent.run() == 3
     assert agent.restart_count == 1
@@ -102,6 +109,7 @@ def test_agent_restarts_on_membership_change(tmp_path):
         hostfile=str(hostfile),
         monitor_interval=0.1,
         max_restarts=3,
+        restart_backoff=NO_BACKOFF,
     )
     import threading
 
@@ -148,6 +156,7 @@ def test_agent_passes_batch_env():
         WorkerSpec(command=[sys.executable, "-c", code]),
         static_world_size=12,
         monitor_interval=0.05,
+        restart_backoff=NO_BACKOFF,
     )
     assert agent.run() == 0
 
@@ -161,6 +170,7 @@ def test_agent_rejects_incompatible_world():
         WorkerSpec(command=[sys.executable, "-c", "pass"]),
         static_world_size=3,
         monitor_interval=0.05,
+        restart_backoff=NO_BACKOFF,
     )
     with pytest.raises(ElasticityIncompatibleWorldSize):
         agent.run()
@@ -253,3 +263,219 @@ def test_local_runner_registered():
     assert isinstance(r, LocalRunner)
     cmds = r.get_cmd(_active(), _node_cmd_for)
     assert len(cmds) == 2 and "--node_rank=0" in " ".join(cmds[0])
+
+
+# ------------------------------------------------ heartbeat + backoff
+def test_agent_kills_hung_worker_on_stale_heartbeat(tmp_path):
+    """A worker that neither exits nor touches its heartbeat file is a hang:
+    the agent SIGKILLs the tree after heartbeat_timeout and relaunches. The
+    second generation exits cleanly, proving the restart path."""
+    hb = tmp_path / "heartbeat"
+    marker = tmp_path / "gen"
+
+    # generation 0: touch the heartbeat once, then wedge (never touch again);
+    # generation 1: exit 0 immediately
+    script = (
+        "import os, pathlib, time\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "gen = int(os.environ['DSTPU_ELASTIC_GENERATION'])\n"
+        "m.write_text(str(gen))\n"
+        "if gen == 0:\n"
+        "    pathlib.Path(os.environ['DSTPU_ELASTIC_HEARTBEAT']).touch()\n"
+        "    time.sleep(60)\n"
+    )
+    agent = DSElasticAgent(
+        ELASTIC_CFG,
+        WorkerSpec(command=[sys.executable, "-c", script]),
+        static_world_size=4,
+        monitor_interval=0.1,
+        max_restarts=2,
+        heartbeat_file=str(hb),
+        heartbeat_timeout=1.5,
+        restart_backoff=NO_BACKOFF,
+    )
+    t0 = time.time()
+    assert agent.run() == 0
+    assert agent.restart_count == 1  # exactly one hung-worker kill
+    assert marker.read_text() == "1"
+    assert time.time() - t0 < 45  # killed by the timeout, not the sleep(60)
+
+
+def test_agent_heartbeat_env_and_fresh_file(tmp_path):
+    """Each generation gets DSTPU_ELASTIC_HEARTBEAT pointing at a freshly
+    re-created file (the hung clock starts at launch)."""
+    hb = tmp_path / "hb"
+    hb.write_text("stale")
+    code = (
+        "import os, sys\n"
+        "p = os.environ['DSTPU_ELASTIC_HEARTBEAT']\n"
+        "sys.exit(0 if os.path.exists(p) and open(p).read() == '' else 7)\n"
+    )
+    agent = DSElasticAgent(
+        ELASTIC_CFG,
+        WorkerSpec(command=[sys.executable, "-c", code]),
+        static_world_size=4,
+        monitor_interval=0.05,
+        heartbeat_file=str(hb),
+        heartbeat_timeout=30.0,
+        restart_backoff=NO_BACKOFF,
+    )
+    assert agent.run() == 0
+
+
+def test_restart_backoff_bounded_jittered_deterministic():
+    from deepspeed_tpu.resilience.retry import RetryPolicy, backoff_delay
+
+    pol = RetryPolicy(max_attempts=10, base_delay_s=1.0, max_delay_s=8.0,
+                      jitter=0.25)
+    d = [backoff_delay(a, pol, seed=3) for a in range(1, 9)]
+    # reproducible across calls (deterministic jitter)
+    assert d == [backoff_delay(a, pol, seed=3) for a in range(1, 9)]
+    # exponential-ish growth inside the jitter envelope, capped at max
+    for a, x in enumerate(d, 1):
+        nominal = min(8.0, 1.0 * 2 ** (a - 1))
+        assert 0.75 * nominal <= x <= 1.25 * nominal
+    # a different seed decorrelates the jitter
+    assert d != [backoff_delay(a, pol, seed=4) for a in range(1, 9)]
+    # dict policies (the agent's restart_backoff=dict path) work too
+    assert backoff_delay(1, RetryPolicy(jitter=0.0)) == 0.5
+
+
+def test_retry_call_survives_transient_surfaces_permanent():
+    from deepspeed_tpu.resilience.retry import RetryPolicy, retry_call
+
+    pol = RetryPolicy(max_attempts=3, base_delay_s=0.0, max_delay_s=0.0,
+                      jitter=0.0)
+    calls = {"n": 0}
+    retried = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, pol,
+                      on_retry=lambda a, e, d: retried.append(a)) == "ok"
+    assert calls["n"] == 3 and retried == [1, 2]
+
+    def broken():
+        raise OSError("permanent")
+
+    with pytest.raises(OSError, match="permanent"):
+        retry_call(broken, pol)
+    # non-retryable exception types pass straight through
+    with pytest.raises(ValueError):
+        retry_call(lambda: (_ for _ in ()).throw(ValueError("x")), pol)
+
+    # no_retry_on carves known-permanent subclasses out of retry_on: the
+    # injector's PermanentIOError must fail on attempt 1 (its write clock
+    # advances across attempts — a blanket OSError retry would mask it)
+    from deepspeed_tpu.resilience import PermanentIOError
+
+    calls["n"] = 0
+
+    def injected_permanent():
+        calls["n"] += 1
+        raise PermanentIOError("fault injection: io_error")
+
+    with pytest.raises(PermanentIOError):
+        retry_call(injected_permanent, pol, retry_on=(OSError,),
+                   no_retry_on=(PermanentIOError,))
+    assert calls["n"] == 1
+
+
+def test_agent_membership_poll_tolerates_torn_hostfile(tmp_path):
+    # a membership poll racing a truncate-then-write hostfile rewrite can
+    # observe a torn line; that is an unreadable SNAPSHOT (world 0, callers
+    # keep the last good world), never a crash out of the supervisor loop
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("node-0 slots=4\n")
+    agent = DSElasticAgent(
+        ELASTIC_CFG,
+        WorkerSpec(command=[sys.executable, "-c", "pass"]),
+        hostfile=str(hostfile))
+    assert agent.current_world_size() == 4
+    hostfile.write_text("node-0 slots=")  # mid-rewrite: torn token
+    assert agent.current_world_size() == 0
+    hostfile.unlink()  # mid-rewrite: file briefly absent
+    assert agent.current_world_size() == 0
+    hostfile.write_text("node-0 slots=4\nnode-1 slots=4\n")
+    assert agent.current_world_size() == 8
+
+    # run() with a permanently unusable hostfile fails TYPED after its
+    # startup grace window, never with an unpack crash out of _resolve
+    hostfile.write_text("node-0 slots=")
+    bad = DSElasticAgent(
+        ELASTIC_CFG,
+        WorkerSpec(command=[sys.executable, "-c", "pass"]),
+        hostfile=str(hostfile), monitor_interval=0.01)
+    with pytest.raises(ValueError, match="no readable hosts"):
+        bad.run()
+
+
+# ------------------------------------------------- dstpu_elastic CLI
+def _run_cli(args):
+    import subprocess
+
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bin", "dstpu_elastic")
+    return subprocess.run([sys.executable, script, *args],
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_dstpu_elastic_exit_codes(tmp_path):
+    """0 = valid (world compatible), 3 = config rejects world size,
+    2 = usage error (missing config). One subprocess per verdict."""
+    cfg = tmp_path / "ds.json"
+    cfg.write_text(json.dumps(ELASTIC_CFG))
+
+    ok = _run_cli(["-c", str(cfg), "-w", "12"])
+    assert ok.returncode == 0 and "micro_batch_per_chip" in ok.stdout
+
+    bad_world = _run_cli(["-c", str(cfg), "-w", "7"])
+    assert bad_world.returncode == 3
+    assert "not in the elastic set" in bad_world.stderr
+
+    missing = _run_cli(["-c", str(tmp_path / "nope.json")])
+    assert missing.returncode == 2 and "cannot read config" in missing.stderr
+
+    # structurally wrong configs fail INSIDE the algebra with raw builtin
+    # errors — still usage (2), never a traceback with a generic exit 1
+    not_dict = tmp_path / "arr.json"
+    not_dict.write_text("[]")
+    r = _run_cli(["-c", str(not_dict)])
+    assert r.returncode == 2 and "malformed config" in r.stderr
+
+    bad_field = tmp_path / "badfield.json"
+    bad = dict(ELASTIC_CFG)
+    bad["elasticity"] = dict(ELASTIC_CFG["elasticity"], micro_batch_sizes="oops")
+    bad_field.write_text(json.dumps(bad))
+    r = _run_cli(["-c", str(bad_field), "-w", "12"])
+    assert r.returncode == 2 and r.stderr.startswith("dstpu_elastic:")
+
+
+def test_heartbeat_startup_grace_vs_step_timeout(tmp_path):
+    """Before the worker's FIRST heartbeat touch, staleness is judged
+    against heartbeat_grace (cold compiles dominate time-to-first-step);
+    after the first touch, the step-cadence timeout applies."""
+    hb = tmp_path / "hb"
+    agent = DSElasticAgent(
+        ELASTIC_CFG,
+        WorkerSpec(command=[sys.executable, "-c", "pass"]),
+        static_world_size=4,
+        heartbeat_file=str(hb), heartbeat_timeout=0.2, heartbeat_grace=30.0)
+    # simulate _launch's bookkeeping without spawning a worker
+    hb.write_text("")
+    agent._hb_launch = time.time()
+    agent._hb_created_mtime = os.path.getmtime(hb)
+    time.sleep(0.3)  # past the step timeout, inside the startup grace
+    assert not agent._heartbeat_stale()  # never touched: still compiling
+    hb.touch()  # first worker heartbeat: step clock takes over
+    assert not agent._heartbeat_stale()
+    time.sleep(0.3)
+    assert agent._heartbeat_stale()  # touched then went quiet: a real hang
+    # default grace derives from the timeout (10x)
+    assert DSElasticAgent(
+        ELASTIC_CFG, WorkerSpec(command=["true"]), static_world_size=4,
+        heartbeat_timeout=2.0).heartbeat_grace == 20.0

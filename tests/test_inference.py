@@ -79,6 +79,10 @@ CASES = {
 }
 
 
+@pytest.mark.slow  # heaviest single tier-1 item (~30s, mostly the HF/torch
+# reference build) on a conversion path no PR has touched since it landed;
+# the decoder-arch HF-parity matrix (test_policy_logits_match_hf) keeps
+# replace_module covered warm — nightly keeps the encoder cross-check
 def test_bert_hidden_states_match_hf():
     """BERT = bidirectional post-LN encoder (policy row the verdict flagged
     missing); features compared against HF last_hidden_state."""

@@ -170,6 +170,10 @@ def test_pipeline_loss_matches_plain_model(num_stages, micro):
     np.testing.assert_allclose(np.asarray(l_plain), np.asarray(l_pipe), rtol=2e-5)
 
 
+@pytest.mark.slow  # grad-of-pipeline tracing is a ~14s tier-1 line item;
+# forward parity (test_pipeline_loss_matches_plain_model) and e2e training
+# (test_pipeline_engine_trains, which differentiates through the pipeline
+# too) keep the warm tier covered — same rationale as ring grad parity
 def test_pipeline_grads_match_plain_model():
     num_stages, micro = 2, 2
     plain = Model(CFG)

@@ -43,6 +43,10 @@ def test_ring_is_causal(ctx_mesh):
     assert not np.allclose(np.asarray(out1[:, -1]), np.asarray(out2[:, -1]))
 
 
+@pytest.mark.slow  # grad-of-shard_map tracing is the single biggest tier-1
+# line item (10-45s run-to-run); forward parity (test_ring_matches_dense /
+# test_ring_is_causal) and e2e training (test_ring_in_model_training, which
+# differentiates through the ring too) keep the warm tier covered
 def test_ring_grad_flows(ctx_mesh):
     B, S, H, Dh = 2, 16, 2, 4
     rng = jax.random.PRNGKey(2)
