@@ -23,6 +23,15 @@ exactly 1 compilation). ``--jsonl PATH`` also streams the raw events
 (spans/compiles/requests/snapshot) for ``python -m
 deepspeed_tpu.telemetry.report PATH``.
 
+``--replicas N`` routes the ragged workload through a multi-replica
+``Router`` (inference/router.py) instead of one engine; ``--kill-replica``
+additionally injects a ``replica_dead`` fault on replica 0 at router step
+``--kill-step`` and ASSERTS the failover contract: every accepted request
+reaches a terminal status, at least one failed-over request completed ok
+(``recovered > 0``), and final slot occupancy is 0 on every surviving
+replica (no leaked slots after failover). The JSON line carries the
+per-replica router table.
+
 ``--workload shared_prefix`` instead replays the prompt-side worst case the
 prefix cache + chunked prefill exist for: N requests sharing one
 ``--prefix-len``-token system prompt with unique tails, run through the
@@ -34,6 +43,7 @@ so a regression in either feature is attributable.
 Usage:  JAX_PLATFORMS=cpu python benchmarks/serving_throughput.py
             [--requests 10] [--slots 4] [--rate 4.0] [--seed 0] [--jsonl PATH]
             [--workload ragged|shared_prefix] [--prefix-len 512]
+            [--replicas 2 [--kill-replica] [--kill-step 10]]
 Prints one JSON line.
 """
 
@@ -259,6 +269,75 @@ def run_shared_prefix(args, engine, cfg):
     }
 
 
+def run_router_smoke(args, engine, cfg):
+    """--replicas N [--kill-replica]: the ragged workload through a Router,
+    optionally with replica 0 killed mid-run. Asserts the failover contract
+    (see module docstring) when the kill is armed."""
+    from deepspeed_tpu.inference.router import Router
+
+    requests = build_workload(args.requests, args.rate, args.seed, cfg.vocab_size)
+    config = {
+        "n_slots": args.slots, "max_seq_len": 256,
+        "jsonl_path": args.jsonl,
+        "router": {"replicas": args.replicas, "health": {"timeout": 30.0}},
+    }
+    if args.kill_replica:
+        config["fault_injection"] = {
+            "enabled": True, "seed": args.seed,
+            "replica_dead_at": [[0, args.kill_step]],
+        }
+    router = Router(engine, config=config)
+    t0 = time.perf_counter()
+    results = router.serve(requests)
+    makespan = time.perf_counter() - t0
+    if args.jsonl:
+        router.telemetry_snapshot()
+
+    stats = router.router_stats()
+    counters = router.telemetry.registry.snapshot()["counters"]
+    missing = [r.uid for r in requests if r.uid not in results]
+    assert not missing, f"requests never reached a terminal status: {missing}"
+    survivors = [r for r in router._replicas if r.state != "dead"]
+    occupancy = {}
+    for r in survivors:
+        e = r.engine
+        occupancy[r.rid] = e.n_active + e.n_prefilling
+        assert occupancy[r.rid] == 0, (
+            f"replica {r.rid} leaked slots: {e.n_active} active + "
+            f"{e.n_prefilling} prefilling after the fleet idled")
+        assert e.n_free + len(e.quarantined_slots) == e.n_slots, (
+            f"replica {r.rid}: {e.n_free} free + "
+            f"{len(e.quarantined_slots)} quarantined != {e.n_slots}")
+    recovered = stats["failovers_recovered"]
+    if args.kill_replica:
+        assert counters.get("router/failovers", 0) > 0, counters
+        assert recovered > 0, (
+            "replica 0 died but no failed-over request completed ok",
+            stats)
+
+    from collections import Counter as _Counter
+
+    total = sum(len(res.tokens) for res in results.values())
+    return {
+        "bench": "serving_router",
+        "requests": args.requests,
+        "slots": args.slots,
+        "replicas": args.replicas,
+        "killed_replica": 0 if args.kill_replica else None,
+        "kill_step": args.kill_step if args.kill_replica else None,
+        "recovered": recovered,
+        "failovers": int(counters.get("router/failovers", 0)),
+        "failed_requests": int(counters.get("router/failed_requests", 0)),
+        "statuses": dict(_Counter(res.status for res in results.values())),
+        "tokens_per_sec": total / makespan if makespan > 0 else 0.0,
+        "total_tokens": int(total),
+        "makespan_sec": makespan,
+        "replica_states": router.replica_states(),
+        "replica_table": stats["replicas"],
+        "surviving_slot_occupancy": occupancy,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=10)
@@ -273,7 +352,17 @@ def main():
                     help="shared system-prompt length (shared_prefix workload)")
     ap.add_argument("--full-matrix", action="store_true",
                     help="also run the single-feature matrix cells")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="route the ragged workload through a Router over "
+                    "N ServingEngine replicas")
+    ap.add_argument("--kill-replica", action="store_true",
+                    help="inject replica_dead on replica 0 at --kill-step "
+                    "and assert the failover contract (needs --replicas >= 2)")
+    ap.add_argument("--kill-step", type=int, default=10,
+                    help="router step (1-based) at which replica 0 dies")
     args = ap.parse_args()
+    if args.kill_replica and args.replicas < 2:
+        ap.error("--kill-replica needs --replicas >= 2 (no failover target)")
 
     import os
     import sys
@@ -301,6 +390,10 @@ def main():
 
     if args.workload == "shared_prefix":
         print(json.dumps(run_shared_prefix(args, engine, cfg)))
+        return
+
+    if args.replicas > 1:
+        print(json.dumps(run_router_smoke(args, engine, cfg)))
         return
 
     requests = build_workload(args.requests, args.rate, args.seed, cfg.vocab_size)

@@ -1,4 +1,5 @@
 """Inference stack (reference: deepspeed/inference/)."""
 
 from .engine import InferenceEngine
-from .serving import Request, RequestResult, ServingEngine
+from .router import Router
+from .serving import Request, RequestResult, ServingEngine, SlotWorker

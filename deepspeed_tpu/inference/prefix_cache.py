@@ -136,6 +136,23 @@ class PrefixIndex:
         self.tokens_reused += best.length
         return best
 
+    def peek(self, tokens, max_len: int) -> int:
+        """Length of the longest cached prefix of ``tokens`` (<= max_len)
+        with NO side effects — no hit/miss counters, no LRU bump, no entry
+        handed out. The Router's prefix-affinity dispatch polls every
+        replica's index per submit; a stats-bumping probe would corrupt
+        hit-rate telemetry and LRU order on the replicas that lose the
+        dispatch. 0 = no cached prefix."""
+        node = self._root
+        best = 0
+        for key in self._blocks(tokens, max_len):
+            node = node.children.get(key)
+            if node is None:
+                break
+            if node.entry is not None:
+                best = node.entry.length
+        return best
+
     def acquire(self, entry: PrefixEntry) -> None:
         entry.refs += 1
 

@@ -1,0 +1,586 @@
+"""Multi-replica serving router: health-checked dispatch, failover, drain.
+
+One ``ServingEngine`` is one host scheduler over one slot cache on one mesh.
+Fleet traffic ("millions of users", ROADMAP) needs N of them behind a single
+``submit()/step()/cancel()`` surface — and a fleet is only as good as its
+failure handling: replicas die, hang, and get rolled. ``Router`` is PURE
+HOST CODE over the existing compiled programs — it only ever talks to
+schedulers (the ``SlotWorker`` boundary extracted in inference/serving.py),
+so replica management can never introduce a new XLA program shape. The
+reference's analogue is the multi-engine inference deployment of
+module_inject + tensor slicing (PAPER.md pillars 3/6); here the fleet
+dimension is host-side replica orchestration:
+
+  * dispatch        — ``submit`` routes to the least-loaded HEALTHY replica;
+                      with ``router.affinity`` on, the replica whose radix
+                      trie already holds the longest match of the prompt
+                      wins first (stat-free ``PrefixIndex.peek``), so
+                      shared-system-prompt traffic lands on the warm cache.
+  * liveness        — a step-latency heartbeat per replica: a scheduler step
+                      observed past ``health.timeout`` is a HUNG verdict, a
+                      step that raises (a dead worker process surfaces as
+                      one) is DEAD. Hung replicas go on probation with the
+                      bounded-backoff schedule of ``resilience/retry.py``
+                      and are re-admitted when it elapses; the
+                      ``health.max_attempts``-th hung verdict escalates to
+                      dead.
+  * failover        — non-terminal requests on a failed replica are
+                      re-dispatched to healthy replicas EXACTLY ONCE; a
+                      replayed request that hits a second replica failure is
+                      failed with terminal status ``failed_replica`` instead
+                      of bouncing forever. Re-dispatched uids enter via
+                      ``ServingEngine.requeue`` — OUTSIDE queue-bound
+                      accounting, the same rule quarantine replays follow —
+                      and a replica that died mid-prefill never
+                      ``prefix_store``'s its faulted KV (the replay prefills
+                      from scratch on a clean replica, so completed greedy
+                      outputs stay bit-identical to an unfaulted run).
+  * draining        — ``drain_replica`` for rolling restarts: stop dispatch,
+                      migrate still-QUEUED requests to siblings, let
+                      in-flight work finish in place, then detach. Zero
+                      accepted requests are lost.
+  * global shedding — ``router.max_queue_len`` bounds arrived-unadmitted
+                      requests ACROSS replicas; past it ``submit`` raises a
+                      typed ``RequestRejected``, mirroring the per-engine
+                      bound from docs/resilience.md.
+
+The terminal-uid contract is the engine's, lifted one level: ``step()``
+returns every uid that reached a terminal state since the last call, across
+all replicas — a direct driver never hangs on a request whose replica died
+mid-flight.
+
+In-process model: every replica shares the caller's ``InferenceEngine``
+(params/mesh), which is exactly the multi-replica-per-host deployment; a
+multi-host fleet would put each ServingEngine in its own process and drive
+the same Router state machine over RPC — the host-side contract
+(owner map, exactly-once failover, drain states) is deployment-agnostic.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..resilience import FaultInjector, RequestRejected
+from ..resilience.retry import backoff_delay
+from ..runtime.config import (FaultInjectionConfig, RouterConfig,
+                              RouterHealthConfig)
+from ..telemetry import Telemetry
+from ..utils.logging import log_dist
+from .engine import InferenceEngine
+from .serving import Request, RequestResult, ServingEngine
+
+
+@dataclass
+class _Replica:
+    """Host-side record for one replica: its scheduler plus the router's
+    view of its health and traffic. ``state`` machine:
+
+        healthy --hung--> probation --backoff elapsed--> healthy
+        healthy/probation --dead/escalation--> dead        (detached)
+        healthy --drain_replica--> draining --idle--> drained (detached)
+    """
+
+    rid: int
+    engine: ServingEngine
+    state: str = "healthy"
+    hung_verdicts: int = 0
+    readmit_at: float = 0.0  # router-clock time probation ends
+    dispatched: int = 0      # requests routed here (submit + failover in)
+    failed_over: int = 0     # requests moved OFF on a dead/hung verdict
+    drained: int = 0         # queued requests migrated off at drain time
+    completed: int = 0       # terminal results recorded from this replica
+
+    @property
+    def accepts(self) -> bool:
+        """Eligible for new dispatch (submit/failover/migration targets)."""
+        return self.state == "healthy"
+
+    @property
+    def stepped(self) -> bool:
+        """Still driven by ``Router.step()`` (draining replicas finish
+        their in-flight work; probation/dead/drained are not stepped)."""
+        return self.state in ("healthy", "draining")
+
+
+class Router:
+    """N ``ServingEngine`` replicas behind one submit/step/cancel surface.
+
+    ``config`` follows the ``serving`` schema of runtime/config.py — the
+    same dict a single ServingEngine takes, with the ``router`` sub-block
+    (``RouterConfig``: replicas / affinity / global ``max_queue_len`` /
+    ``health``) consumed here and everything else handed to each replica.
+    Every replica gets its own private telemetry registry (no counter-name
+    collisions) plus ``replica_id=<rid>``; the router keeps a separate
+    bundle for ``router/*`` metrics and the one JSONL sink.
+    """
+
+    def __init__(self, engine: InferenceEngine, config: dict | None = None,
+                 *, replicas: int | None = None,
+                 telemetry: Telemetry | None = None):
+        config = dict(config or {})
+        rc = config.get("router", {})
+        if isinstance(rc, dict):
+            rc = RouterConfig(**rc)
+        if replicas is not None:
+            rc.replicas = int(replicas)
+            if rc.replicas < 1:
+                raise ValueError(f"replicas must be >= 1, got {rc.replicas}")
+        self.cfg: RouterConfig = rc
+        self.health: RouterHealthConfig = rc.health
+        self.affinity = bool(rc.affinity)
+        self.max_queue_len = int(rc.max_queue_len)
+
+        fi = config.get("fault_injection", {})
+        if isinstance(fi, dict):
+            fi = FaultInjectionConfig(**fi)
+        # the router's OWN injector consumes the replica_* sites; each
+        # replica engine builds its own from the same block for the
+        # request-level sites (garbage_logits) — independent counters
+        self._inj: Optional[FaultInjector] = (
+            FaultInjector(fi) if fi.enabled else None)
+        self._seed = int(fi.seed) if fi.enabled else 0
+
+        self.telemetry = telemetry if telemetry is not None else Telemetry(
+            jsonl_path=config.get("jsonl_path", ""),
+            watchdog_mode=config.get("watchdog_mode", "warn"),
+        )
+        self._epoch = time.perf_counter()
+        sub = dict(config)
+        # ONE sink at the router — N replicas appending to one JSONL path
+        # would interleave half-written lines
+        sub.pop("jsonl_path", None)
+        self._replicas: list[_Replica] = []
+        for rid in range(rc.replicas):
+            e = ServingEngine(engine, config=sub, replica_id=rid)
+            # one clock across the fleet: replica-relative timings
+            # (queue wait, TTFT) stay comparable and step(now=...) means
+            # the same instant on every replica
+            e.set_epoch(self._epoch)
+            self._replicas.append(_Replica(rid, e))
+        self._owner: dict[int, int] = {}      # live uid -> replica id
+        self._seen: dict[int, set] = {}       # uid -> replicas that held it
+        self._failovers: dict[int, int] = {}  # uid -> failover count
+        self._results: dict[int, RequestResult] = {}
+        # uids made terminal OUTSIDE a step (cancel()) — drained into the
+        # next step()'s return so the terminal-uid contract stays complete
+        self._pending_terminal: list[int] = []
+        self._steps = 0
+        self.telemetry.gauge("router/replicas").set(rc.replicas)
+        self._update_gauges()
+        log_dist(
+            f"serving router: {rc.replicas} replicas, health.timeout="
+            f"{self.health.timeout}s, affinity={self.affinity}, "
+            f"global max_queue_len={self.max_queue_len or 'unbounded'}",
+            ranks=[0])
+
+    # -- dispatch --------------------------------------------------------
+
+    def _accepting(self) -> list[_Replica]:
+        return [r for r in self._replicas if r.accepts]
+
+    def _pick(self, candidates: list[_Replica], request: Request) -> _Replica:
+        """Prefix-affinity first (longest stat-free trie match wins), then
+        least-loaded with replica-id tiebreak."""
+        if self.affinity:
+            best, best_len = None, 0
+            for r in candidates:
+                n = r.engine.prefix_match_len(request.prompt)
+                if n > best_len:
+                    best, best_len = r, n
+            if best is not None:
+                self.telemetry.counter("router/affinity_hits").inc()
+                return best
+        return min(candidates, key=lambda r: (r.engine.load, r.rid))
+
+    def submit(self, request: Request) -> int:
+        """Route a request to the best healthy replica. Raises typed
+        ``RequestRejected`` when no replica accepts dispatch
+        (``no_healthy_replicas``) or the GLOBAL arrived-queue bound is hit
+        (``queue_full``); per-replica bounds may still reject underneath."""
+        tm = self.telemetry
+        healthy = self._accepting()
+        if not healthy:
+            tm.counter("router/shed").inc()
+            raise RequestRejected(
+                request.uid, "no_healthy_replicas",
+                f"0 of {len(self._replicas)} replicas accepting dispatch")
+        now = time.perf_counter() - self._epoch
+        if self.max_queue_len and request.arrival_time <= now:
+            # same population rule as the per-engine bound: requeued uids
+            # (quarantine replays, failovers) sit outside the accounting
+            arrived = sum(r.engine.arrived_queue_len(now)
+                          for r in self._replicas if r.stepped)
+            if arrived >= self.max_queue_len:
+                tm.counter("router/shed").inc()
+                raise RequestRejected(
+                    request.uid, "queue_full",
+                    f"{arrived} arrived requests across {len(healthy)} "
+                    f"healthy replicas (router max_queue_len="
+                    f"{self.max_queue_len})")
+        if request.uid in self._owner or request.uid in self._results:
+            # same guard the engine applies per replica, lifted fleet-wide:
+            # two submits with one uid would land on DIFFERENT replicas
+            # (each engine only sees its own state), overwrite the owner
+            # map, and silently drop the first request's result
+            raise ValueError(
+                f"request uid {request.uid} is already in flight or "
+                "finished; uids must be unique per router")
+        target = self._pick(healthy, request)
+        uid = target.engine.submit(request)
+        self._owner[uid] = target.rid
+        self._seen.setdefault(uid, set()).add(target.rid)
+        target.dispatched += 1
+        tm.counter("router/dispatched").inc()
+        self._update_gauges()
+        return uid
+
+    def cancel(self, uid: int) -> bool:
+        """Cancel wherever the request lives; the terminal ``cancelled``
+        result is recorded immediately AND the uid is still returned by the
+        next ``step()`` (the lifted terminal-uid contract covers every
+        terminal path, like the engine's). False if unknown/already done."""
+        rid = self._owner.get(uid)
+        if rid is None:
+            return False
+        r = self._replicas[rid]
+        if not r.engine.cancel(uid):
+            return False
+        self._record(r, uid)
+        self._pending_terminal.append(uid)
+        return True
+
+    # -- health / failover ----------------------------------------------
+
+    def _record(self, r: _Replica, uid: int) -> None:
+        res = r.engine.result(uid)
+        if res is None or self._owner.get(uid) != r.rid:
+            return
+        self._results[uid] = res
+        r.completed += 1
+        del self._owner[uid]
+        self._seen.pop(uid, None)
+
+    def _collect(self, r: _Replica, uids, terminal: list) -> None:
+        for uid in uids:
+            if self._owner.get(uid) == r.rid and uid not in self._results:
+                self._record(r, uid)
+                terminal.append(uid)
+
+    def _synth_result(self, req: Request, status: str) -> RequestResult:
+        now = time.perf_counter() - self._epoch
+        res = RequestResult(
+            uid=req.uid, tokens=np.zeros((0,), np.int32),
+            prompt_len=int(np.asarray(req.prompt).shape[-1]),
+            arrival_time=req.arrival_time, finish_time=now, status=status)
+        self._results[req.uid] = res
+        self.telemetry.emit({
+            "type": "request", "uid": req.uid, "slot": -1,
+            "prompt_len": res.prompt_len, "n_tokens": 0, "status": status,
+            "arrival_s": req.arrival_time, "finish_s": now,
+        })
+        return res
+
+    def _failover(self, req: Request, terminal: list) -> None:
+        """Re-dispatch one request off a failed replica — exactly once per
+        uid, never back to a replica that already held it."""
+        tm = self.telemetry
+        n = self._failovers.get(req.uid, 0)
+        seen = self._seen.setdefault(req.uid, set())
+        targets = [r for r in self._replicas
+                   if r.accepts and r.rid not in seen]
+        if n >= 1 or not targets:
+            self._owner.pop(req.uid, None)
+            self._seen.pop(req.uid, None)
+            self._synth_result(req, "failed_replica")
+            terminal.append(req.uid)
+            tm.counter("router/failed_requests").inc()
+            log_dist(
+                f"router: request {req.uid} failed_replica "
+                f"({'failover already spent' if n >= 1 else 'no clean replica left'})",
+                ranks=[0])
+            return
+        self._failovers[req.uid] = n + 1
+        tgt = self._pick(targets, req)
+        tgt.engine.requeue(req)
+        self._owner[req.uid] = tgt.rid
+        seen.add(tgt.rid)
+        tgt.dispatched += 1
+        tm.counter("router/failovers").inc()
+
+    def _fail(self, r: _Replica, verdict: str, now: float,
+              terminal: list) -> None:
+        """Apply a hung/dead verdict: move the replica through its state
+        machine and fail over every request it still owned."""
+        tm = self.telemetry
+        live = [req for req in r.engine.live_requests()
+                if self._owner.get(req.uid) == r.rid]
+        if verdict == "hung":
+            r.hung_verdicts += 1
+            tm.counter("router/hung_verdicts").inc()
+            if r.hung_verdicts >= self.health.max_attempts:
+                verdict = "dead"  # probation budget exhausted
+            elif r.state == "draining":
+                # a replica being retired gets no probation: re-admitting it
+                # would hand fresh traffic to a replica the operator is
+                # about to kill. The drain becomes a failover — its work
+                # replays elsewhere, the replica detaches now.
+                verdict = "dead"
+        if verdict == "dead":
+            r.state = "dead"
+            tm.counter("router/replicas_dead").inc()
+            log_dist(f"router: replica {r.rid} marked DEAD "
+                     f"({len(live)} in-flight requests failing over)",
+                     ranks=[0])
+        else:
+            # probation: re-admitted after the retry-policy backoff for
+            # this verdict count (deterministic jitter, decorrelated by
+            # replica id like multi-host checkpoint retries)
+            delay = backoff_delay(r.hung_verdicts, self.health,
+                                  seed=self._seed + r.rid)
+            r.readmit_at = now + delay
+            r.state = "probation"
+            log_dist(
+                f"router: replica {r.rid} HUNG (verdict "
+                f"{r.hung_verdicts}/{self.health.max_attempts}); probation "
+                f"{delay:.2f}s, {len(live)} requests failing over", ranks=[0])
+            # abandon its work host-side so a re-admitted replica doesn't
+            # keep decoding requests that now live elsewhere (its cancelled
+            # results are ignored: the owner map has moved on)
+            for req in live:
+                r.engine.cancel(req.uid)
+        r.failed_over += len(live)
+        for req in live:
+            self._failover(req, terminal)
+        self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        tm = self.telemetry
+        tm.gauge("router/healthy_replicas").set(
+            sum(1 for r in self._replicas if r.state == "healthy"))
+        tm.gauge("router/live_requests").set(len(self._owner))
+
+    # -- stepping --------------------------------------------------------
+
+    def step(self, now: float | None = None, *,
+             enforce_deadlines: bool = True) -> list[int]:
+        """One fleet iteration: re-admit probation replicas whose backoff
+        elapsed, then step every healthy/draining replica once (injecting
+        ``replica_dead``/``replica_hang`` verdicts where armed), timing each
+        step as its liveness heartbeat. Returns every uid that reached a
+        terminal state across the fleet since the last call."""
+        if now is None:
+            now = time.perf_counter() - self._epoch
+        tm = self.telemetry
+        self._steps += 1
+        terminal: list[int] = self._pending_terminal
+        self._pending_terminal = []
+        for r in self._replicas:
+            if r.state == "probation" and now >= r.readmit_at:
+                r.state = "healthy"
+                tm.counter("router/readmissions").inc()
+                log_dist(f"router: replica {r.rid} re-admitted from "
+                         f"probation", ranks=[0])
+        for r in self._replicas:
+            if not r.stepped:
+                continue
+            if self._inj is not None and self._inj.replica_dead(
+                    r.rid, self._steps):
+                tm.counter("resilience/injected_faults").inc()
+                self._fail(r, "dead", now, terminal)
+                continue
+            t0 = time.perf_counter()
+            try:
+                uids = r.engine.step(now=now,
+                                     enforce_deadlines=enforce_deadlines)
+            except Exception as e:  # noqa: BLE001 — a dead worker IS an exception
+                log_dist(f"router: replica {r.rid} step raised "
+                         f"{type(e).__name__}: {e}", ranks=[0])
+                self._fail(r, "dead", now, terminal)
+                continue
+            latency = time.perf_counter() - t0
+            compiled = r.engine.last_step_compiled
+            if self._inj is not None and self._inj.replica_hang(
+                    r.rid, self._steps):
+                tm.counter("resilience/injected_faults").inc()
+                # synthetic heartbeat overrun: the verdict path under test
+                # without wall-clock sleeps
+                latency = max(latency, self.health.timeout * 2.0 + 1e-3)
+                compiled = False
+            if not compiled:
+                # compiling steps are excluded from BOTH the latency
+                # histogram and the hung verdict — a cold replica's first
+                # step compiles for tens of seconds on real hardware, and
+                # burning every request's exactly-once failover budget on
+                # that is a false positive (same exclusion rule the
+                # engine's latency histograms apply via last_call_compiled)
+                tm.histogram("router/replica_step_sec").observe(latency)
+            # completions from this step are REAL even if the step then
+            # draws a hung verdict — record before judging
+            self._collect(r, uids, terminal)
+            if (self.health.timeout > 0 and not compiled
+                    and latency > self.health.timeout):
+                self._fail(r, "hung", now, terminal)
+                continue
+            if r.state == "draining" and r.engine.idle:
+                r.state = "drained"
+                tm.counter("router/replicas_drained").inc()
+                log_dist(f"router: replica {r.rid} drained and detached",
+                         ranks=[0])
+                self._update_gauges()
+        tm.gauge("router/queue_depth").set(
+            sum(r.engine.queue_len for r in self._replicas if r.stepped))
+        self._update_gauges()
+        return terminal
+
+    # -- draining / drivers ---------------------------------------------
+
+    def drain_replica(self, rid: int, *, block: bool = True) -> None:
+        """Rolling-restart drain: stop dispatching to replica ``rid``,
+        migrate its still-QUEUED requests to accepting siblings (not a
+        failover — nothing failed, so the exactly-once budget is untouched),
+        let in-flight prefills/decodes finish in place, then detach
+        (state ``drained``). With no accepting sibling the queued requests
+        stay and finish HERE before detach — drain never strands or sheds an
+        accepted request. ``block=False`` returns after migration; the
+        replica detaches during subsequent ``step()`` calls."""
+        r = self._replicas[rid]
+        if r.state != "healthy":
+            raise ValueError(
+                f"replica {rid} is {r.state}; only a healthy replica can "
+                "start draining")
+        r.state = "draining"
+        self.telemetry.counter("router/drains").inc()
+        self._update_gauges()
+        targets = self._accepting()
+        if targets:
+            for req in list(r.engine.live_requests()):
+                if self._owner.get(req.uid) != r.rid:
+                    continue
+                # never migrate onto a replica that already held this uid
+                # (e.g. it cancelled the uid in a past hung-failover — its
+                # engine's duplicate-uid guard would reject the requeue);
+                # with no clean target the request simply finishes in place
+                # on the draining replica, which keeps stepping
+                eligible = [t for t in targets
+                            if t.rid not in self._seen.get(req.uid, set())]
+                if not eligible:
+                    continue
+                w = r.engine.withdraw(req.uid)
+                if w is None:
+                    continue  # already admitted — finishes in place
+                tgt = self._pick(eligible, w)
+                tgt.engine.requeue(w)
+                self._owner[w.uid] = tgt.rid
+                self._seen.setdefault(w.uid, set()).add(tgt.rid)
+                tgt.dispatched += 1
+                r.drained += 1
+                self.telemetry.counter("router/migrated_requests").inc()
+        log_dist(f"router: draining replica {rid} "
+                 f"({r.drained} queued requests migrated, "
+                 f"{r.engine.load} finishing in place)", ranks=[0])
+        if block:
+            while r.state == "draining":
+                now = time.perf_counter() - self._epoch
+                self.step()
+                # future-dated queued work (no accepting sibling took it)
+                # finishes at wall-clock pace — idle-wait instead of
+                # hot-looping host scans, mirroring serve()
+                pending = r.engine.pending_arrival_times()
+                if (all(x.engine.idle for x in self._replicas if x.stepped)
+                        and pending):
+                    wait = min(pending) - now
+                    if wait > 0:
+                        time.sleep(min(wait, 0.05))
+
+    def drain(self) -> dict[int, RequestResult]:
+        """Run the whole fleet to completion (ignoring arrival times and
+        deadlines, like ``ServingEngine.drain``); returns all results."""
+        while self._owner:
+            self.step(now=float("inf"), enforce_deadlines=False)
+        return dict(self._results)
+
+    def serve(self, requests: list[Request]) -> dict[int, RequestResult]:
+        """Wall-clock driver mirroring ``ServingEngine.serve``: submit each
+        request (a load-shed one still gets a ``shed_*`` result rather than
+        an exception), then step the fleet until every submitted uid is
+        terminal."""
+        if not self._owner:
+            self._epoch = time.perf_counter()
+            for r in self._replicas:
+                r.engine.set_epoch(self._epoch)
+        target = set()
+        for req in sorted(requests, key=lambda r: r.arrival_time):
+            try:
+                target.add(self.submit(req))
+            except RequestRejected as e:
+                self._synth_result(req, "shed_" + e.reason)
+                target.add(req.uid)
+        while not target <= set(self._results):
+            now = time.perf_counter() - self._epoch
+            busy = any(not r.engine.idle for r in self._replicas if r.stepped)
+            if not busy:
+                pending = [t for r in self._replicas if r.stepped
+                           for t in r.engine.pending_arrival_times()]
+                if pending:
+                    wait = min(pending) - now
+                    if wait > 0:
+                        time.sleep(min(wait, 0.05))
+            self.step()
+        return {u: self._results[u] for u in target}
+
+    # -- observability ---------------------------------------------------
+
+    @property
+    def results(self) -> dict[int, RequestResult]:
+        return dict(self._results)
+
+    def replica_states(self) -> dict[int, str]:
+        return {r.rid: r.state for r in self._replicas}
+
+    def router_stats(self) -> dict:
+        """Host-side fleet view: per-replica health state and traffic
+        counts — the table ``python -m deepspeed_tpu.telemetry.report``
+        renders."""
+        out = {
+            "steps": self._steps,
+            "live_requests": len(self._owner),
+            # failed-over requests whose replay COMPLETED ok — the
+            # "recovered" number the bench smoke asserts on
+            "failovers_recovered": sum(
+                1 for uid, n in self._failovers.items()
+                if n and uid in self._results and self._results[uid].ok),
+            "replicas": {
+                r.rid: {
+                    "state": r.state,
+                    "dispatched": r.dispatched,
+                    "failed_over": r.failed_over,
+                    "drained": r.drained,
+                    "completed": r.completed,
+                    "hung_verdicts": r.hung_verdicts,
+                    "load": r.engine.load,
+                } for r in self._replicas
+            },
+        }
+        if self._inj is not None:
+            out["fault_injection"] = self._inj.stats()
+        return out
+
+    def telemetry_snapshot(self) -> dict:
+        """The fleet in one call: the router's own registry + per-replica
+        ``ServingEngine.telemetry_snapshot()``s, kept under their replica
+        ids so counter names never collide across replicas. Appended to the
+        router's JSONL sink (type ``snapshot``) when one is configured."""
+        snap = {
+            "router": {
+                "metrics": self.telemetry.registry.snapshot(),
+                **self.router_stats(),
+            },
+            "replicas": {r.rid: r.engine.telemetry_snapshot()
+                         for r in self._replicas},
+        }
+        self.telemetry.emit({"type": "snapshot", **snap})
+        return snap

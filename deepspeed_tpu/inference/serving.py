@@ -201,119 +201,42 @@ class _Prefill:
     t_admit: float = 0.0  # epoch-relative admission time
 
 
-class ServingEngine:
-    """Continuous batching over an ``InferenceEngine``'s model/params.
+class SlotWorker:
+    """The compiled-program driver half of the serving engine.
 
-    Config keys (``config`` dict or keyword arguments; kwargs win —
-    the ``serving`` block of runtime/config.py is this dict's schema):
-      n_slots             concurrent sequences resident in the slot cache
-      max_seq_len         per-slot admission budget (prompt + generated);
-                          must not exceed the engine's sequence budget. Only
-                          the cache allocation rounds up to a multiple of
-                          128 (Pallas decode-kernel block streaming).
-                          Default: the engine's sequence budget.
-      min_prefill_bucket  smallest prompt bucket (power of two padding floor)
-      seed                sampler PRNG seed
-      jsonl_path          telemetry JSONL event log ("" = off)
-      watchdog_mode       off|warn|raise when a compile-stable path
-                          compiles a second time (default warn)
-      prefix_cache        {enabled, n_slots, max_prefix_len, block,
-                          insert_policy, min_hits} — prompt-prefix KV reuse
-                          (runtime/config.PrefixCacheConfig; docs/serving.md)
-      chunked_prefill     {enabled, chunk_size, chunks_per_step} — admission
-                          chunks interleaved with decode
-                          (runtime/config.ChunkedPrefillConfig)
-      max_queue_len       bound on ARRIVED not-yet-admitted requests; excess
-                          arrivals are load-shed with a typed reason
-                          (0 = unbounded; docs/resilience.md)
-      default_deadline_s  deadline applied to requests without their own
-                          (seconds after arrival; 0 = none)
-      quarantine_max_requeues   clean replays granted to a request whose
-                          logits went non-finite before it is failed
-      slot_quarantine_after     consecutive NaN faults in one slot before
-                          that slot is pulled from rotation
-      fault_injection     {enabled, seed, rate, garbage_logits_*} —
-                          deterministic NaN-logit injection
-                          (runtime/config.FaultInjectionConfig)
+    The serving engine is really two machines. The HOST SCHEDULER
+    (``ServingEngine``) owns requests: queues, admission, deadlines,
+    shedding, quarantine — pure host state transitions. This worker owns
+    the DEVICE: the slot KV cache, the prefix pool, the sampler PRNG, and
+    the small inventory of long-lived compiled programs that touch them.
+    Every public method here is exactly one host→device dispatch; nothing
+    in this class knows about requests, arrival times, or health.
 
-    Telemetry is always on (host-side dict updates per step — decode already
-    pays a device call): TTFT/TPOT histograms, queue depth, slot occupancy,
-    admissions/evictions, per-bucket prefill counts, prefix-cache hit/reuse
-    counters + pool-occupancy gauge, chunks-per-admit histogram, and a
-    recompile watchdog over decode (stable: ONE program), each prefill
-    bucket, each chunk width, and the prefix fetch/store programs.
-    ``telemetry_snapshot()`` reports everything in one call; pass
-    ``telemetry=`` to share a bundle across engines.
+    The boundary is what makes fleet serving possible as pure host code:
+    a ``Router`` (inference/router.py) drives N schedulers — and therefore
+    N workers — from one process, and replica management (liveness,
+    failover, draining) never introduces a new XLA program shape, because
+    it only ever talks to schedulers.
     """
 
-    def __init__(self, engine: InferenceEngine, config: dict | None = None,
-                 *, n_slots: int | None = None, max_seq_len: int | None = None,
-                 min_prefill_bucket: int | None = None, seed: int | None = None,
-                 telemetry: Telemetry | None = None,
-                 prefix_cache: PrefixCacheConfig | dict | None = None,
-                 chunked_prefill: ChunkedPrefillConfig | dict | None = None,
-                 fault_injection: FaultInjectionConfig | dict | None = None):
-        config = dict(config or {})
-        n_slots = n_slots if n_slots is not None else config.get("n_slots", 8)
-        max_seq_len = max_seq_len if max_seq_len is not None else config.get(
-            "max_seq_len", 0)
-        # 0/None = the engine's sequence budget — the typed schema's default
-        # (runtime/config.ServingConfig.max_seq_len=0), so a dataclass dump
-        # of the `serving` block drops in unchanged
-        max_seq_len = max_seq_len or min(engine.cfg.max_seq_len, engine.max_out_tokens)
-        min_prefill_bucket = (min_prefill_bucket if min_prefill_bucket is not None
-                              else config.get("min_prefill_bucket", 16))
-        seed = seed if seed is not None else config.get("seed", 0)
-        self.telemetry = telemetry if telemetry is not None else Telemetry(
-            jsonl_path=config.get("jsonl_path", ""),
-            watchdog_mode=config.get("watchdog_mode", "warn"),
-        )
-        pc = prefix_cache if prefix_cache is not None else config.get("prefix_cache", {})
-        if isinstance(pc, dict):
-            pc = PrefixCacheConfig(**pc)
-        cp = (chunked_prefill if chunked_prefill is not None
-              else config.get("chunked_prefill", {}))
-        if isinstance(cp, dict):
-            cp = ChunkedPrefillConfig(**cp)
-        self.prefix_cfg: PrefixCacheConfig = pc
-        self.chunk_cfg: ChunkedPrefillConfig = cp
-
-        # -- degradation knobs (docs/resilience.md) ---------------------
-        self.max_queue_len = int(config.get("max_queue_len", 0))
-        self.default_deadline_s = float(config.get("default_deadline_s", 0.0))
-        self.quarantine_max_requeues = int(config.get("quarantine_max_requeues", 1))
-        self.slot_quarantine_after = int(config.get("slot_quarantine_after", 2))
-        fi = (fault_injection if fault_injection is not None
-              else config.get("fault_injection", {}))
-        if isinstance(fi, dict):
-            fi = FaultInjectionConfig(**fi)
-        self._inj: Optional[FaultInjector] = (
-            FaultInjector(fi) if fi.enabled else None)
-
+    def __init__(self, engine: InferenceEngine, telemetry: Telemetry,
+                 n_slots: int, budget: int, seed: int,
+                 prefix_cfg: PrefixCacheConfig):
         self.engine = engine
         self.cfg = engine.cfg
         self.mesh = engine.mesh
         self.params = engine.params
+        self.telemetry = telemetry
         self.n_slots = int(n_slots)
-        # admission budget stays at the MODEL's sequence limit (a learned
-        # position table indexes out of range past it — jax clamps the gather
-        # and the output would be silently wrong); only the cache ALLOCATION
-        # rounds up to the 128 multiple the decode kernel's block streaming
-        # needs — those tail positions are never admitted into
-        engine_budget = min(engine.cfg.max_seq_len, engine.max_out_tokens)
-        self.budget = int(max_seq_len)
-        if self.budget > engine_budget:
-            raise ValueError(
-                f"max_seq_len ({self.budget}) exceeds the engine's sequence "
-                f"budget {engine_budget} (min of model max_seq_len "
-                f"{engine.cfg.max_seq_len} and max_out_tokens "
-                f"{engine.max_out_tokens})")
-        self.Smax = -(-self.budget // 128) * 128
-        self.min_bucket = int(min_prefill_bucket)
+        # only the cache ALLOCATION rounds up to the 128 multiple the decode
+        # kernel's block streaming needs — the scheduler's admission budget
+        # stays at the model's limit, so those tail positions are never
+        # admitted into
+        self.Smax = -(-int(budget) // 128) * 128
         self._rng = jax.random.PRNGKey(seed)
 
-        spec = kv_slot_cache_spec(self.mesh, self.n_slots, self.cfg.num_heads)
-        self._cache_sharding = NamedSharding(self.mesh, spec)
+        self.spec = kv_slot_cache_spec(self.mesh, self.n_slots, self.cfg.num_heads)
+        self._cache_sharding = NamedSharding(self.mesh, self.spec)
         # every program pins the cache OUTPUT to this sharding too — an
         # inferred output sharding that differs from the input's would give
         # the next call a differently-sharded operand and silently recompile
@@ -326,74 +249,37 @@ class ServingEngine:
 
         # prefix pool: the slot cache's sibling — same [L, slots, len, H, Dh]
         # layout, holding cached prompt prefixes instead of live sequences
-        self._pfx: Optional[PrefixIndex] = None
+        self.pmax = 0
         self._pool = None
-        if pc.enabled:
-            self._pmax = int(pc.max_prefix_len) or self.Smax
-            if self._pmax > self.Smax:
+        if prefix_cfg.enabled:
+            self.pmax = int(prefix_cfg.max_prefix_len) or self.Smax
+            if self.pmax > self.Smax:
                 raise ValueError(
-                    f"prefix_cache.max_prefix_len ({self._pmax}) exceeds the "
+                    f"prefix_cache.max_prefix_len ({self.pmax}) exceeds the "
                     f"slot cache length {self.Smax}")
-            pool_spec = kv_prefix_pool_spec(self.mesh, pc.n_slots, self.cfg.num_heads)
+            pool_spec = kv_prefix_pool_spec(self.mesh, prefix_cfg.n_slots,
+                                            self.cfg.num_heads)
             self._pool_sharding = NamedSharding(self.mesh, pool_spec)
             self._pool_shardings = {"k": self._pool_sharding, "v": self._pool_sharding}
             self._pool = jax.jit(
-                partial(tfm.init_cache, self.cfg, pc.n_slots, self._pmax,
+                partial(tfm.init_cache, self.cfg, prefix_cfg.n_slots, self.pmax,
                         dtype=self.cfg.dtype),
                 out_shardings=self._pool_sharding,
             )()
-            self._pfx = PrefixIndex(pc.n_slots, pc.block,
-                                    insert_policy=pc.insert_policy,
-                                    min_hits=pc.min_hits)
-            self.telemetry.gauge("serving/prefix_pool_slots").set(pc.n_slots)
 
-        # host-side slot state (device twins are passed per step as arrays)
-        n = self.n_slots
-        self._slots = [_Slot() for _ in range(n)]
-        self._free: deque[int] = deque(range(n))
-        self._active = np.zeros((n,), np.bool_)
-        self._pos = np.zeros((n,), np.int32)
-        self._last_tok = np.zeros((n,), np.int32)
-        self._temp = np.zeros((n,), np.float32)
-        self._top_k = np.zeros((n,), np.int32)
-        self._top_p = np.ones((n,), np.float32)
-
-        self._queue: deque[Request] = deque()
-        self._prefilling: dict[int, _Prefill] = {}  # slot -> admission state
-        self._rr = 0  # round-robin cursor over prefilling slots
-        self._results: dict[int, RequestResult] = {}
-        # quarantine bookkeeping: per-uid replay count, per-slot consecutive
-        # NaN-fault count, and slots pulled from rotation (suspect hardware)
-        self._requeues: dict[int, int] = {}
-        self._slot_faults = np.zeros((n,), np.int32)
-        self._quarantined_slots: set[int] = set()
-        self._poison = None  # jitted slot-KV NaN poke (fault injection only)
-        # uids that reached a terminal state since the last step() returned —
-        # step() drains this so callers driving the scheduler directly see
-        # EVERY completion (ok, expired, shed, deadline, cancelled, failed),
-        # not just EOS/length finishes
-        self._terminal_uids: list[int] = []
-        # deadline sweeping costs an O(queue + slots) host pass per decode
-        # step; skip it entirely until some live request can actually expire
-        self._deadlines_armed = self.default_deadline_s > 0
-        self._epoch = time.perf_counter()
         self._decode = None  # jitted lazily (params pytree shapes needed)
         self._prefills: dict[int, object] = {}  # bucket len -> jitted prefill
         self._chunk_progs: dict[int, object] = {}  # chunk width -> jitted chunk
         self._fetch = None  # jitted prefix pool -> slot copy
         self._store = None  # jitted slot -> prefix pool copy
+        self._poison = None  # jitted slot-KV fill (fault injection/scrub)
         self._decode_steps = 0
-        feat = []
-        if pc.enabled:
-            feat.append(f"prefix_cache[{pc.n_slots}x{self._pmax}, "
-                        f"block {pc.block}, {pc.insert_policy}]")
-        if cp.enabled:
-            feat.append(f"chunked_prefill[{cp.chunk_size}]")
-        log_dist(
-            f"serving engine: {n} slots x {self.Smax} tokens, cache "
-            f"{2 * self.cfg.num_layers * n * self.Smax * self.cfg.hidden_size * jnp.dtype(self.cfg.dtype).itemsize / 1e6:.1f} MB, "
-            f"spec={spec}" + (", " + ", ".join(feat) if feat else ""), ranks=[0],
-        )
+        # True if ANY dispatch since the scheduler last reset it paid a
+        # compilation — the Router's step-latency heartbeat exempts such
+        # steps (a cold replica's first step compiles for tens of seconds
+        # on real hardware; that is not a hang), the same rule the latency
+        # histograms already apply via last_call_compiled
+        self.step_compiled = False
 
     # -- compiled programs ----------------------------------------------
 
@@ -480,7 +366,7 @@ class ServingEngine:
                        out_shardings=(self._cache_shardings, None, None))
 
     def _build_fetch(self):
-        pmax = self._pmax
+        pmax = self.pmax
 
         def fetch(cache, pool, pool_slot, slot):
             # the whole [0, Pmax) window is copied (static width — ONE
@@ -494,7 +380,7 @@ class ServingEngine:
                        out_shardings=self._cache_shardings)
 
     def _build_store(self):
-        pmax = self._pmax
+        pmax = self.pmax
 
         def store(pool, cache, slot, pool_slot):
             return tfm.update_cache_slot(
@@ -503,7 +389,132 @@ class ServingEngine:
         return jax.jit(store, donate_argnums=(0,),
                        out_shardings=self._pool_shardings)
 
-    def _fill_slot(self, slot: int, value: float) -> None:
+    def _chunk_prog(self, width: int):
+        if width not in self._chunk_progs:
+            wd = self.telemetry.watchdog
+            self._chunk_progs[width] = wd.watch(
+                self._build_chunk(width),
+                wd.unique_name(f"serving/chunk_prefill[{width}]"), stable=True)
+        return self._chunk_progs[width]
+
+    # -- dispatches ------------------------------------------------------
+
+    def decode(self, last_tok, pos, wpos, active, temp, top_k, top_p):
+        """Advance EVERY slot one token — THE compile-stable path: a second
+        compilation means an operand's shape/dtype/sharding drifted and
+        every admission would pay a retrace (the watchdog warns or raises
+        per config). Returns host ``(next_token, bad_sentinel)`` [n_slots]
+        arrays; the fetch syncs, so the recorded latency is device-true."""
+        tm = self.telemetry
+        if self._decode is None:
+            wd = tm.watchdog
+            self._decode = wd.watch(
+                self._build_decode(), wd.unique_name("serving/decode"),
+                stable=True)
+        self._rng, k = jax.random.split(self._rng)
+        t0 = time.perf_counter()
+        self._cache, nxt, bad = self._decode(
+            self.params, self._cache, jnp.asarray(last_tok),
+            jnp.asarray(pos), jnp.asarray(wpos, np.int32),
+            jnp.asarray(active), k,
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+        )
+        self._decode_steps += 1
+        self.step_compiled |= bool(self._decode.last_call_compiled)
+        nxt, bad = (np.asarray(x) for x in jax.device_get((nxt, bad)))
+        # nxt is fetched: the decode program has fully executed on device.
+        # The compiling call is excluded from the latency histogram (it is
+        # compile/wall_s's datum, and would otherwise be the p99)
+        if not self._decode.last_call_compiled:
+            tm.histogram("serving/decode_step_sec").observe(
+                time.perf_counter() - t0)
+        tm.counter("serving/decode_steps").inc()
+        return nxt, bad
+
+    def prefill(self, bucket: int, padded, slot: int, true_len: int,
+                temperature: float, top_k: int, top_p: float):
+        """One-shot bucketed prompt prefill into ``slot``. Returns the host
+        ``(first_token, bad)`` pair; the fetch syncs."""
+        tm = self.telemetry
+        if bucket not in self._prefills:
+            # each bucket length is its own compile-stable program: one
+            # compile at first use, never again
+            wd = tm.watchdog
+            self._prefills[bucket] = wd.watch(
+                self._build_prefill(bucket),
+                wd.unique_name(f"serving/prefill[{bucket}]"), stable=True)
+        self._rng, k = jax.random.split(self._rng)
+        t0 = time.perf_counter()
+        self._cache, tok, bad = self._prefills[bucket](
+            self.params, self._cache, jnp.asarray(padded),
+            jnp.int32(slot), jnp.int32(true_len), k,
+            jnp.asarray([temperature], jnp.float32),
+            jnp.asarray([top_k], jnp.int32),
+            jnp.asarray([top_p], jnp.float32),
+        )
+        self.step_compiled |= bool(self._prefills[bucket].last_call_compiled)
+        tok_h, bad_h = jax.device_get((tok, bad))
+        # the token fetch above synced, so this wall time is device-true;
+        # the compiling call is excluded — compile/wall_s records it, and
+        # folding it in would make the latency tail pure compile time
+        if not self._prefills[bucket].last_call_compiled:
+            tm.histogram("serving/prefill_sec").observe(time.perf_counter() - t0)
+        tm.counter(f"serving/prefill_bucket[{bucket}]").inc()
+        return int(np.asarray(tok_h)[0]), bool(np.asarray(bad_h).reshape(-1)[0])
+
+    def chunk(self, width: int, toks, slot: int, start: int, live: int,
+              temperature: float, top_k: int, top_p: float, *, fetch: bool):
+        """One prompt chunk through the ``width`` program. ``fetch=False``
+        (intermediate chunk) returns None and leaves the dispatch async —
+        the sampled token is garbage mid-prompt logits, and the next decode
+        step overlaps with the chunk; the FINAL chunk fetches and returns
+        ``(first_token, bad)``."""
+        prog = self._chunk_prog(width)
+        tm = self.telemetry
+        self._rng, k = jax.random.split(self._rng)
+        t0 = time.perf_counter()
+        self._cache, tok, bad = prog(
+            self.params, self._cache, jnp.asarray(toks),
+            jnp.int32(slot), jnp.int32(start), jnp.int32(live), k,
+            jnp.asarray([temperature], jnp.float32),
+            jnp.asarray([top_k], jnp.int32),
+            jnp.asarray([top_p], jnp.float32),
+        )
+        tm.counter(f"serving/chunk_bucket[{width}]").inc()
+        self.step_compiled |= bool(prog.last_call_compiled)
+        if not fetch:
+            return None
+        tok_h, bad_h = jax.device_get((tok, bad))
+        # device-true (the fetch synced); the compiling call is excluded
+        if not prog.last_call_compiled:
+            tm.histogram("serving/chunk_prefill_sec").observe(
+                time.perf_counter() - t0)
+        return int(np.asarray(tok_h)[0]), bool(np.asarray(bad_h).reshape(-1)[0])
+
+    def prefix_fetch(self, pool_slot: int, slot: int) -> None:
+        """Copy a prefix-pool window into ``slot`` (ONE compiled program;
+        slot indices are traced operands)."""
+        if self._fetch is None:
+            wd = self.telemetry.watchdog
+            self._fetch = wd.watch(
+                self._build_fetch(),
+                wd.unique_name("serving/prefix_fetch"), stable=True)
+        self._cache = self._fetch(
+            self._cache, self._pool, jnp.int32(pool_slot), jnp.int32(slot))
+        self.step_compiled |= bool(self._fetch.last_call_compiled)
+
+    def prefix_store(self, slot: int, pool_slot: int) -> None:
+        """Copy ``slot``'s leading window into the prefix pool."""
+        if self._store is None:
+            wd = self.telemetry.watchdog
+            self._store = wd.watch(
+                self._build_store(),
+                wd.unique_name("serving/prefix_store"), stable=True)
+        self._pool = self._store(
+            self._pool, self._cache, jnp.int32(slot), jnp.int32(pool_slot))
+        self.step_compiled |= bool(self._store.last_call_compiled)
+
+    def fill_slot(self, slot: int, value: float) -> None:
         """Overwrite one slot's whole KV row with ``value`` — ONE compiled
         program (slot and value are traced operands), cache sharding pinned
         so the decode program's operand never drifts (no decode recompile).
@@ -518,6 +529,8 @@ class ServingEngine:
         every later occupant's logits even though the mask "hides" it —
         NaN-faulted KV must never survive into a reused slot."""
         if self._poison is None:
+            self.step_compiled = True  # first fill call compiles the program
+
             def fill(cache, slot, val):
                 return {
                     kv: cache[kv].at[:, slot].set(val)
@@ -530,16 +543,211 @@ class ServingEngine:
             self._cache, jnp.int32(slot),
             jnp.asarray(value, self._cache["k"].dtype))
 
+    def compile_counts(self) -> dict:
+        """How many XLA programs this worker traced — the continuous-batching
+        invariant is decode == 1 regardless of workload mix, and every chunk
+        width / prefix copy is likewise ONE program."""
+        out = {
+            "decode": int(self._decode._cache_size()) if self._decode is not None else 0,
+            "prefill": {b: int(f._cache_size()) for b, f in sorted(self._prefills.items())},
+            "decode_steps": self._decode_steps,
+        }
+        if self._chunk_progs:
+            out["chunk_prefill"] = {w: int(f._cache_size())
+                                    for w, f in sorted(self._chunk_progs.items())}
+        if self._fetch is not None:
+            out["prefix_fetch"] = int(self._fetch._cache_size())
+        if self._store is not None:
+            out["prefix_store"] = int(self._store._cache_size())
+        return out
+
+
+class ServingEngine:
+    """Continuous batching over an ``InferenceEngine``'s model/params.
+
+    This class is the HOST SCHEDULER half of the serving engine — queues,
+    admission, deadlines, shedding, quarantine, the terminal-uid contract.
+    All device state and compiled programs live in ``self.worker``
+    (``SlotWorker``), and ``inference/router.py`` builds a fleet by putting
+    N of these schedulers behind one Router.
+
+    Config keys (``config`` dict or keyword arguments; kwargs win —
+    the ``serving`` block of runtime/config.py is this dict's schema;
+    a ``router`` sub-block is consumed by ``Router``, not here):
+      n_slots             concurrent sequences resident in the slot cache
+      max_seq_len         per-slot admission budget (prompt + generated);
+                          must not exceed the engine's sequence budget. Only
+                          the cache allocation rounds up to a multiple of
+                          128 (Pallas decode-kernel block streaming).
+                          Default: the engine's sequence budget.
+      min_prefill_bucket  smallest prompt bucket (power of two padding floor)
+      seed                sampler PRNG seed
+      replica_id          engine identity stamped into telemetry_snapshot()
+                          (a Router assigns one per replica)
+      jsonl_path          telemetry JSONL event log ("" = off)
+      watchdog_mode       off|warn|raise when a compile-stable path
+                          compiles a second time (default warn)
+      prefix_cache        {enabled, n_slots, max_prefix_len, block,
+                          insert_policy, min_hits} — prompt-prefix KV reuse
+                          (runtime/config.PrefixCacheConfig; docs/serving.md)
+      chunked_prefill     {enabled, chunk_size, chunks_per_step} — admission
+                          chunks interleaved with decode
+                          (runtime/config.ChunkedPrefillConfig)
+      max_queue_len       bound on ARRIVED not-yet-admitted requests; excess
+                          arrivals are load-shed with a typed reason
+                          (0 = unbounded; docs/resilience.md)
+      default_deadline_s  deadline applied to requests without their own
+                          (seconds after arrival; 0 = none)
+      quarantine_max_requeues   clean replays granted to a request whose
+                          logits went non-finite before it is failed
+      slot_quarantine_after     consecutive NaN faults in one slot before
+                          that slot is pulled from rotation
+      fault_injection     {enabled, seed, rate, garbage_logits_*} —
+                          deterministic NaN-logit injection
+                          (runtime/config.FaultInjectionConfig)
+
+    Telemetry is always on (host-side dict updates per step — decode already
+    pays a device call): TTFT/TPOT histograms, queue depth, slot occupancy,
+    admissions/evictions, per-bucket prefill counts, prefix-cache hit/reuse
+    counters + pool-occupancy gauge, chunks-per-admit histogram, and a
+    recompile watchdog over decode (stable: ONE program), each prefill
+    bucket, each chunk width, and the prefix fetch/store programs.
+    ``telemetry_snapshot()`` reports everything in one call; pass
+    ``telemetry=`` to share a bundle across engines.
+    """
+
+    def __init__(self, engine: InferenceEngine, config: dict | None = None,
+                 *, n_slots: int | None = None, max_seq_len: int | None = None,
+                 min_prefill_bucket: int | None = None, seed: int | None = None,
+                 telemetry: Telemetry | None = None,
+                 replica_id: int | str | None = None,
+                 prefix_cache: PrefixCacheConfig | dict | None = None,
+                 chunked_prefill: ChunkedPrefillConfig | dict | None = None,
+                 fault_injection: FaultInjectionConfig | dict | None = None):
+        config = dict(config or {})
+        config.pop("router", None)  # the Router's block, not this engine's
+        n_slots = n_slots if n_slots is not None else config.get("n_slots", 8)
+        max_seq_len = max_seq_len if max_seq_len is not None else config.get(
+            "max_seq_len", 0)
+        # 0/None = the engine's sequence budget — the typed schema's default
+        # (runtime/config.ServingConfig.max_seq_len=0), so a dataclass dump
+        # of the `serving` block drops in unchanged
+        max_seq_len = max_seq_len or min(engine.cfg.max_seq_len, engine.max_out_tokens)
+        min_prefill_bucket = (min_prefill_bucket if min_prefill_bucket is not None
+                              else config.get("min_prefill_bucket", 16))
+        seed = seed if seed is not None else config.get("seed", 0)
+        self.telemetry = telemetry if telemetry is not None else Telemetry(
+            jsonl_path=config.get("jsonl_path", ""),
+            watchdog_mode=config.get("watchdog_mode", "warn"),
+        )
+        pc = prefix_cache if prefix_cache is not None else config.get("prefix_cache", {})
+        if isinstance(pc, dict):
+            pc = PrefixCacheConfig(**pc)
+        cp = (chunked_prefill if chunked_prefill is not None
+              else config.get("chunked_prefill", {}))
+        if isinstance(cp, dict):
+            cp = ChunkedPrefillConfig(**cp)
+        self.prefix_cfg: PrefixCacheConfig = pc
+        self.chunk_cfg: ChunkedPrefillConfig = cp
+
+        # -- degradation knobs (docs/resilience.md) ---------------------
+        self.max_queue_len = int(config.get("max_queue_len", 0))
+        self.default_deadline_s = float(config.get("default_deadline_s", 0.0))
+        self.quarantine_max_requeues = int(config.get("quarantine_max_requeues", 1))
+        self.slot_quarantine_after = int(config.get("slot_quarantine_after", 2))
+        fi = (fault_injection if fault_injection is not None
+              else config.get("fault_injection", {}))
+        if isinstance(fi, dict):
+            fi = FaultInjectionConfig(**fi)
+        self._inj: Optional[FaultInjector] = (
+            FaultInjector(fi) if fi.enabled else None)
+
+        self.engine = engine
+        self.cfg = engine.cfg
+        # NOTE: no mesh/params here — all device state lives in the worker;
+        # this scheduler is pure host code
+        self.n_slots = int(n_slots)
+        # engine identity for fleet snapshots: every telemetry_snapshot()
+        # carries it, so a Router's merged view stays attributable
+        self.replica_id = (replica_id if replica_id is not None
+                           else config.get("replica_id", 0))
+        # admission budget stays at the MODEL's sequence limit (a learned
+        # position table indexes out of range past it — jax clamps the gather
+        # and the output would be silently wrong); the WORKER's cache
+        # allocation rounds up to the 128 multiple the decode kernel needs
+        engine_budget = min(engine.cfg.max_seq_len, engine.max_out_tokens)
+        self.budget = int(max_seq_len)
+        if self.budget > engine_budget:
+            raise ValueError(
+                f"max_seq_len ({self.budget}) exceeds the engine's sequence "
+                f"budget {engine_budget} (min of model max_seq_len "
+                f"{engine.cfg.max_seq_len} and max_out_tokens "
+                f"{engine.max_out_tokens})")
+        self.min_bucket = int(min_prefill_bucket)
+
+        # the compiled-program driver: device state + program inventory
+        # (this scheduler is pure host code from here on)
+        self.worker = SlotWorker(engine, self.telemetry, self.n_slots,
+                                 self.budget, seed, pc)
+        self.Smax = self.worker.Smax
+
+        # host-side prefix index: the radix trie mapping prompt prefixes to
+        # the worker's pool slots (scheduler state — the pool is device)
+        self._pfx: Optional[PrefixIndex] = None
+        if pc.enabled:
+            self._pfx = PrefixIndex(pc.n_slots, pc.block,
+                                    insert_policy=pc.insert_policy,
+                                    min_hits=pc.min_hits)
+            self.telemetry.gauge("serving/prefix_pool_slots").set(pc.n_slots)
+
+        # host-side slot state (device twins are passed per step as arrays)
+        n = self.n_slots
+        self._slots = [_Slot() for _ in range(n)]
+        self._free: deque[int] = deque(range(n))
+        self._active = np.zeros((n,), np.bool_)
+        self._pos = np.zeros((n,), np.int32)
+        self._last_tok = np.zeros((n,), np.int32)
+        self._temp = np.zeros((n,), np.float32)
+        self._top_k = np.zeros((n,), np.int32)
+        self._top_p = np.ones((n,), np.float32)
+
+        self._queue: deque[Request] = deque()
+        self._prefilling: dict[int, _Prefill] = {}  # slot -> admission state
+        self._rr = 0  # round-robin cursor over prefilling slots
+        self._results: dict[int, RequestResult] = {}
+        # quarantine bookkeeping: per-uid replay count, per-slot consecutive
+        # NaN-fault count, and slots pulled from rotation (suspect hardware)
+        self._requeues: dict[int, int] = {}
+        self._slot_faults = np.zeros((n,), np.int32)
+        self._quarantined_slots: set[int] = set()
+        # uids exempt from queue-bound accounting: a Router's failover /
+        # drain requeues were already accepted once — like quarantine
+        # replays, they are neither shed nor allowed to displace arrivals
+        self._exempt_uids: set[int] = set()
+        # uids that reached a terminal state since the last step() returned —
+        # step() drains this so callers driving the scheduler directly see
+        # EVERY completion (ok, expired, shed, deadline, cancelled, failed),
+        # not just EOS/length finishes
+        self._terminal_uids: list[int] = []
+        # deadline sweeping costs an O(queue + slots) host pass per decode
+        # step; skip it entirely until some live request can actually expire
+        self._deadlines_armed = self.default_deadline_s > 0
+        self._epoch = time.perf_counter()
+        feat = []
+        if pc.enabled:
+            feat.append(f"prefix_cache[{pc.n_slots}x{self.worker.pmax}, "
+                        f"block {pc.block}, {pc.insert_policy}]")
+        if cp.enabled:
+            feat.append(f"chunked_prefill[{cp.chunk_size}]")
+        log_dist(
+            f"serving engine: {n} slots x {self.Smax} tokens, cache "
+            f"{2 * self.cfg.num_layers * n * self.Smax * self.cfg.hidden_size * jnp.dtype(self.cfg.dtype).itemsize / 1e6:.1f} MB, "
+            f"spec={self.worker.spec}" + (", " + ", ".join(feat) if feat else ""),
+            ranks=[0],
+        )
+
     def _bucket_len(self, S: int) -> int:
         return min(_next_pow2(max(S, self.min_bucket)), self.Smax)
-
-    def _chunk_prog(self, width: int):
-        if width not in self._chunk_progs:
-            wd = self.telemetry.watchdog
-            self._chunk_progs[width] = wd.watch(
-                self._build_chunk(width),
-                wd.unique_name(f"serving/chunk_prefill[{width}]"), stable=True)
-        return self._chunk_progs[width]
 
     def _segments(self, start: int, S: int) -> list[tuple[int, int, int]]:
         """Split [start, S) into (start, width, live_len) chunk segments:
@@ -599,13 +807,12 @@ class ServingEngine:
             # queued — it is shed at step() time if the queue is still full
             # when it arrives). Typed rejection instead of unbounded growth.
             now = time.perf_counter() - self._epoch
-            if request.arrival_time <= now:
-                # same population as _shed_overflow: quarantine-requeued
-                # requests sit outside the bound accounting, so a transient
-                # fault never shrinks admission capacity
-                arrived = sum(1 for r in self._queue
-                              if r.arrival_time <= now
-                              and self._requeues.get(r.uid, 0) == 0)
+            if (request.arrival_time <= now
+                    and request.uid not in self._exempt_uids):
+                # same population as _shed_overflow: quarantine replays and
+                # router requeues sit outside the bound accounting, so a
+                # transient fault never shrinks admission capacity
+                arrived = self.arrived_queue_len(now)
                 if arrived >= self.max_queue_len:
                     self.telemetry.counter("resilience/load_shed").inc()
                     raise RequestRejected(
@@ -616,6 +823,108 @@ class ServingEngine:
             self._deadlines_armed = True
         self._queue.append(request)
         return request.uid
+
+    # -- router-facing surface (inference/router.py) --------------------
+
+    def requeue(self, request: Request) -> int:
+        """Re-admission entry for the Router's failover / drain migration:
+        the request was already ACCEPTED once by this process, so it
+        re-enters a queue OUTSIDE the queue-bound accounting — the same
+        rule quarantine replays follow (docs/resilience.md). It is neither
+        shed nor allowed to displace newly-accepted arrivals; the backlog
+        may transiently overshoot by the number of in-flight failovers."""
+        self._exempt_uids.add(int(request.uid))
+        try:
+            return self.submit(request)
+        except BaseException:
+            self._exempt_uids.discard(int(request.uid))
+            raise
+
+    def withdraw(self, uid: int) -> Optional[Request]:
+        """Silently remove a still-QUEUED request and hand it back (no
+        result is synthesized — unlike ``cancel``, the request is not
+        terminal, it is MOVING: the Router's drain path re-queues it on a
+        sibling replica). None if the uid is not queued here."""
+        for i, r in enumerate(self._queue):
+            if r.uid == uid:
+                del self._queue[i]
+                self._exempt_uids.discard(uid)
+                return r
+        return None
+
+    def result(self, uid: int) -> Optional[RequestResult]:
+        """The terminal result for ``uid``, or None while in flight."""
+        return self._results.get(uid)
+
+    def live_requests(self) -> list[Request]:
+        """Accepted, non-terminal requests in scheduler order (queued, then
+        mid-prefill, then decoding) — the population a Router fails over
+        when this replica is declared dead or hung."""
+        out = list(self._queue)
+        out.extend(pf.req for _, pf in sorted(self._prefilling.items()))
+        out.extend(st.request for slot, st in enumerate(self._slots)
+                   if self._active[slot] and st.request is not None)
+        return out
+
+    def arrived_queue_len(self, now: float | None = None) -> int:
+        """ARRIVED not-yet-admitted requests that count toward the queue
+        bound — quarantine replays and router failover/drain requeues sit
+        outside the accounting. This is the population ``submit`` and
+        ``_shed_overflow`` police, and what a Router sums across replicas
+        for its global bound."""
+        if now is None:
+            now = time.perf_counter() - self._epoch
+        return sum(1 for r in self._queue
+                   if r.arrival_time <= now
+                   and self._requeues.get(r.uid, 0) == 0
+                   and r.uid not in self._exempt_uids)
+
+    def prefix_match_len(self, prompt) -> int:
+        """Longest cached-prefix match (tokens) for ``prompt`` with NO side
+        effects — no hit/miss counters, no LRU bump (``PrefixIndex.peek``).
+        The Router's affinity dispatch polls every replica per submit; a
+        stats-bumping probe would corrupt hit-rate telemetry and LRU order
+        on the replicas that lose the dispatch. 0 when the feature is off."""
+        if self._pfx is None:
+            return 0
+        p = np.asarray(prompt).reshape(-1)
+        if p.shape[0] < 2:
+            return 0
+        return self._pfx.peek(p, min(p.shape[0] - 1, self.worker.pmax))
+
+    @property
+    def load(self) -> int:
+        """Scheduler load for least-loaded dispatch: queued + mid-prefill +
+        decoding requests."""
+        return len(self._queue) + len(self._prefilling) + self.n_active
+
+    @property
+    def idle(self) -> bool:
+        return (not self._queue and not self._prefilling
+                and not self._active.any())
+
+    @property
+    def queue_len(self) -> int:
+        """Requests queued (arrived or future-dated), not yet admitted."""
+        return len(self._queue)
+
+    def pending_arrival_times(self) -> list[float]:
+        """Arrival times of every queued request — the Router's idle-wait
+        reads these instead of reaching into the queue representation."""
+        return [r.arrival_time for r in self._queue]
+
+    def set_epoch(self, epoch: float) -> None:
+        """Align this engine's clock with a Router's (one epoch across the
+        fleet keeps queue-wait/TTFT timings and ``step(now=...)`` coherent).
+        Call only while idle — in-flight requests' timings are epoch-relative."""
+        self._epoch = float(epoch)
+
+    @property
+    def last_step_compiled(self) -> bool:
+        """True if the most recent ``step()`` paid at least one program
+        compilation — the Router's liveness heartbeat exempts such steps
+        from the hung verdict (compiling is not hanging)."""
+        return self.worker.step_compiled
 
     @property
     def n_active(self) -> int:
@@ -672,19 +981,12 @@ class ServingEngine:
                 # at most S-1 tokens are reusable: the first sampled token
                 # needs the LAST prompt position's logits, so at least one
                 # suffix token must run through a prefill program
-                entry = self._pfx.lookup(prompt, min(S - 1, self._pmax))
+                entry = self._pfx.lookup(prompt, min(S - 1, self.worker.pmax))
                 if entry is not None:
                     self._pfx.acquire(entry)
                     tm.counter("serving/prefix_hits").inc()
                     tm.counter("serving/prefix_tokens_reused").inc(entry.length)
-                    if self._fetch is None:
-                        wd = tm.watchdog
-                        self._fetch = wd.watch(
-                            self._build_fetch(),
-                            wd.unique_name("serving/prefix_fetch"), stable=True)
-                    self._cache = self._fetch(
-                        self._cache, self._pool,
-                        jnp.int32(entry.pool_slot), jnp.int32(slot))
+                    self.worker.prefix_fetch(entry.pool_slot, slot)
                 else:
                     tm.counter("serving/prefix_misses").inc()
             P = entry.length if entry is not None else 0
@@ -711,38 +1013,14 @@ class ServingEngine:
 
     def _prefill_one_shot(self, req: Request, slot: int, prompt: np.ndarray,
                           t_adm: float, entry):
-        tm = self.telemetry
         S = prompt.shape[0]
         bucket = self._bucket_len(S)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :S] = prompt
-        if bucket not in self._prefills:
-            # each bucket length is its own compile-stable program: one
-            # compile at first use, never again
-            wd = tm.watchdog
-            self._prefills[bucket] = wd.watch(
-                self._build_prefill(bucket),
-                wd.unique_name(f"serving/prefill[{bucket}]"), stable=True)
-        self._rng, k = jax.random.split(self._rng)
-        t_pre = time.perf_counter()
-        self._cache, tok, bad = self._prefills[bucket](
-            self.params, self._cache, jnp.asarray(padded),
-            jnp.int32(slot), jnp.int32(S), k,
-            jnp.asarray([req.temperature], jnp.float32),
-            jnp.asarray([req.top_k], jnp.int32),
-            jnp.asarray([req.top_p], jnp.float32),
-        )
-        tok_h, bad_h = jax.device_get((tok, bad))
-        first = int(np.asarray(tok_h)[0])
+        first, bad = self.worker.prefill(
+            bucket, padded, slot, S, req.temperature, req.top_k, req.top_p)
         t_first = time.perf_counter() - self._epoch
-        # the token fetch above synced, so this wall time is device-true;
-        # the compiling call is excluded — compile/wall_s records it, and
-        # folding it in would make the latency tail pure compile time
-        if not self._prefills[bucket].last_call_compiled:
-            tm.histogram("serving/prefill_sec").observe(time.perf_counter() - t_pre)
-        tm.counter(f"serving/prefill_bucket[{bucket}]").inc()
-        self._activate(slot, req, prompt, first, t_adm, t_first, entry,
-                       bad=bool(np.asarray(bad_h).reshape(-1)[0]))
+        self._activate(slot, req, prompt, first, t_adm, t_first, entry, bad=bad)
 
     def _advance_prefill(self, slot: int):
         """Run ONE chunk of the slot's admission prefill; on the final chunk
@@ -751,35 +1029,22 @@ class ServingEngine:
         start, width, live = pf.segments[pf.idx]
         toks = np.zeros((1, width), np.int32)
         toks[0, :live] = pf.prompt[start:start + live]
-        prog = self._chunk_prog(width)
-        tm = self.telemetry
-        self._rng, k = jax.random.split(self._rng)
-        t0 = time.perf_counter()
-        self._cache, tok, bad = prog(
-            self.params, self._cache, jnp.asarray(toks),
-            jnp.int32(slot), jnp.int32(start), jnp.int32(live), k,
-            jnp.asarray([pf.req.temperature], jnp.float32),
-            jnp.asarray([pf.req.top_k], jnp.int32),
-            jnp.asarray([pf.req.top_p], jnp.float32),
-        )
-        tm.counter(f"serving/chunk_bucket[{width}]").inc()
         pf.idx += 1
-        if pf.idx < len(pf.segments):
+        out = self.worker.chunk(
+            width, toks, slot, start, live, pf.req.temperature,
+            pf.req.top_k, pf.req.top_p, fetch=pf.idx >= len(pf.segments))
+        if out is None:
             # intermediate chunk: the sampled token is garbage (mid-prompt
             # logits) and deliberately NOT fetched — the chunk stays an
             # async dispatch the next decode step overlaps with. A NaN here
             # propagates through attention to the final chunk, whose fetched
             # sentinel covers the whole prefill.
             return
-        tok_h, bad_h = jax.device_get((tok, bad))
-        first = int(np.asarray(tok_h)[0])
+        first, bad = out
         t_first = time.perf_counter() - self._epoch
-        # device-true (the fetch synced); the compiling call is excluded
-        if not prog.last_call_compiled:
-            tm.histogram("serving/chunk_prefill_sec").observe(time.perf_counter() - t0)
         del self._prefilling[slot]
         self._activate(slot, pf.req, pf.prompt, first, pf.t_admit, t_first,
-                       pf.entry, bad=bool(np.asarray(bad_h).reshape(-1)[0]))
+                       pf.entry, bad=bad)
 
     def _activate(self, slot: int, req: Request, prompt: np.ndarray,
                   first: int, t_adm: float, t_first: float, entry,
@@ -794,7 +1059,7 @@ class ServingEngine:
             # make the fault REAL: the slot KV is NaN-poisoned, so an engine
             # that ignored the sentinel would store poisoned prefix KV and
             # decode garbage — the parity tests would catch it
-            self._fill_slot(slot, float("nan"))
+            self.worker.fill_slot(slot, float("nan"))
             self.telemetry.counter("resilience/injected_faults").inc()
             bad = True
         if bad:
@@ -835,18 +1100,11 @@ class ServingEngine:
         compiled store program."""
         tm = self.telemetry
         skips_before = self._pfx.insert_skips
-        res = self._pfx.insert(prompt, min(prompt.shape[0] - 1, self._pmax))
+        res = self._pfx.insert(prompt, min(prompt.shape[0] - 1, self.worker.pmax))
         if res.evicted is not None:
             tm.counter("serving/prefix_evictions").inc()
         if res.created:
-            if self._store is None:
-                wd = tm.watchdog
-                self._store = wd.watch(
-                    self._build_store(),
-                    wd.unique_name("serving/prefix_store"), stable=True)
-            self._pool = self._store(
-                self._pool, self._cache, jnp.int32(slot),
-                jnp.int32(res.entry.pool_slot))
+            self.worker.prefix_store(slot, res.entry.pool_slot)
             tm.counter("serving/prefix_inserts").inc()
         elif self._pfx.insert_skips > skips_before:
             # the index declined (pool full of in-use prefixes / below the
@@ -862,6 +1120,7 @@ class ServingEngine:
         st.result.requeues = self._requeues.get(st.uid, 0)
         self._results[st.uid] = st.result
         self._terminal_uids.append(st.uid)
+        self._exempt_uids.discard(st.uid)
         res = st.result
         tm = self.telemetry
         tm.counter("serving/evictions").inc()
@@ -928,6 +1187,7 @@ class ServingEngine:
             status=status, requeues=self._requeues.get(req.uid, 0))
         self._results[req.uid] = res
         self._terminal_uids.append(req.uid)
+        self._exempt_uids.discard(req.uid)
         self.telemetry.emit({
             "type": "request", "uid": req.uid, "slot": slot,
             "prompt_len": res.prompt_len, "n_tokens": 0, "status": status,
@@ -964,8 +1224,8 @@ class ServingEngine:
                 # a mid-prefill slot's KV is UNVERIFIED (intermediate-chunk
                 # sentinels are never fetched) — scrub before reuse, else an
                 # undetected NaN leaks into the next occupant through masked
-                # attention (see _fill_slot)
-                self._fill_slot(slot, 0.0)
+                # attention (see SlotWorker.fill_slot)
+                self.worker.fill_slot(slot, 0.0)
                 self._release_slot(slot)
                 tm.counter("resilience/cancelled").inc()
                 return True
@@ -993,7 +1253,7 @@ class ServingEngine:
                 self._synth_result(pf.req, "deadline_exceeded", slot=slot)
                 # mid-prefill KV is unverified — scrub before reuse (see
                 # the same path in cancel())
-                self._fill_slot(slot, 0.0)
+                self.worker.fill_slot(slot, 0.0)
                 self._release_slot(slot)
                 tm.counter("resilience/deadline_evictions").inc()
         for slot in range(self.n_slots):
@@ -1014,9 +1274,14 @@ class ServingEngine:
         number of in-flight faults (<= n_slots)."""
         if not self.max_queue_len:
             return
+        # same population as arrived_queue_len: quarantine replays AND
+        # router failover/drain requeues sit outside the accounting — an
+        # exempt requeue must neither be shed nor displace an accepted
+        # arrival over the bound
         arrived = [r for r in self._queue
                    if r.arrival_time <= now
-                   and self._requeues.get(r.uid, 0) == 0]
+                   and self._requeues.get(r.uid, 0) == 0
+                   and r.uid not in self._exempt_uids]
         excess = len(arrived) - self.max_queue_len
         if excess <= 0:
             return
@@ -1034,8 +1299,8 @@ class ServingEngine:
         tm = self.telemetry
         tm.counter("resilience/quarantines").inc()
         # scrub before the slot can be reused: NaN KV anywhere in the row
-        # poisons later occupants through masked attention (see _fill_slot)
-        self._fill_slot(slot, 0.0)
+        # poisons later occupants through masked attention (see SlotWorker.fill_slot)
+        self.worker.fill_slot(slot, 0.0)
         self._slot_faults[slot] += 1
         healthy = self.n_slots - len(self._quarantined_slots)
         if (self._slot_faults[slot] >= self.slot_quarantine_after
@@ -1074,6 +1339,7 @@ class ServingEngine:
         if now is None:
             now = time.perf_counter() - self._epoch
         tm = self.telemetry
+        self.worker.step_compiled = False  # fresh heartbeat window
         if enforce_deadlines:
             if self._deadlines_armed:
                 self._sweep_deadlines(now)
@@ -1098,14 +1364,6 @@ class ServingEngine:
             finished = self._terminal_uids
             self._terminal_uids = []
             return finished
-        if self._decode is None:
-            # THE compile-stable path: a second compilation here means an
-            # operand's shape/dtype/sharding drifted and every admission
-            # would pay a retrace — the watchdog warns or raises per config
-            wd = self.telemetry.watchdog
-            self._decode = wd.watch(
-                self._build_decode(), wd.unique_name("serving/decode"),
-                stable=True)
         n_active = int(self._active.sum())
         tm.gauge("serving/active_slots").set(n_active)
         tm.histogram("serving/queue_depth_hist").observe(len(self._queue))
@@ -1118,9 +1376,8 @@ class ServingEngine:
                 st = self._slots[slot]
                 if self._active[slot] and self._inj.garbage_logits(
                         st.uid, "decode", len(st.tokens) - 1):
-                    self._fill_slot(slot, float("nan"))
+                    self.worker.fill_slot(slot, float("nan"))
                     tm.counter("resilience/injected_faults").inc()
-        self._rng, k = jax.random.split(self._rng)
         # inactive slots WRITE at position Smax — the cache scatter's
         # mode="drop" discards their garbage KV entirely. Writing at 0 (the
         # pre-chunked-prefill scheme) corrupted PREFILLING slots — a slot
@@ -1129,22 +1386,9 @@ class ServingEngine:
         # ATTENTION position stays self._pos (0 when idle), so the
         # length-aware decode kernel never streams the full cache for them.
         wpos = np.where(self._active, self._pos, np.int32(self.Smax))
-        t_dec = time.perf_counter()
-        self._cache, nxt, bad = self._decode(
-            self.params, self._cache, jnp.asarray(self._last_tok),
-            jnp.asarray(self._pos), jnp.asarray(wpos, np.int32),
-            jnp.asarray(self._active), k,
-            jnp.asarray(self._temp), jnp.asarray(self._top_k),
-            jnp.asarray(self._top_p),
-        )
-        self._decode_steps += 1
-        nxt, bad = (np.asarray(x) for x in jax.device_get((nxt, bad)))
-        # nxt is fetched: the decode program has fully executed on device.
-        # The compiling call is excluded from the latency histogram (it is
-        # compile/wall_s's datum, and would otherwise be the p99)
-        if not self._decode.last_call_compiled:
-            tm.histogram("serving/decode_step_sec").observe(time.perf_counter() - t_dec)
-        tm.counter("serving/decode_steps").inc()
+        nxt, bad = self.worker.decode(
+            self._last_tok, self._pos, wpos, self._active,
+            self._temp, self._top_k, self._top_p)
         for slot in range(self.n_slots):
             if not self._active[slot]:
                 continue
@@ -1214,22 +1458,10 @@ class ServingEngine:
     # -- observability --------------------------------------------------
 
     def compile_counts(self) -> dict:
-        """How many XLA programs this engine traced — the continuous-batching
-        invariant is decode == 1 regardless of workload mix, and every chunk
-        width / prefix copy is likewise ONE program."""
-        out = {
-            "decode": int(self._decode._cache_size()) if self._decode is not None else 0,
-            "prefill": {b: int(f._cache_size()) for b, f in sorted(self._prefills.items())},
-            "decode_steps": self._decode_steps,
-        }
-        if self._chunk_progs:
-            out["chunk_prefill"] = {w: int(f._cache_size())
-                                    for w, f in sorted(self._chunk_progs.items())}
-        if self._fetch is not None:
-            out["prefix_fetch"] = int(self._fetch._cache_size())
-        if self._store is not None:
-            out["prefix_store"] = int(self._store._cache_size())
-        return out
+        """How many XLA programs this engine's worker traced — the
+        continuous-batching invariant is decode == 1 regardless of workload
+        mix, and every chunk width / prefix copy is likewise ONE program."""
+        return self.worker.compile_counts()
 
     def prefix_cache_stats(self) -> Optional[dict]:
         """Host-side prefix-cache view: hit/miss/reuse totals, pool
@@ -1241,9 +1473,10 @@ class ServingEngine:
         """ONE call that reports everything: the metrics registry (TTFT/TPOT/
         queue/occupancy histograms, admission/eviction/token counters), the
         recompile table, the XLA program counts, the trace-time collective
-        summary, and the prefix-cache table when the feature is on. Also
-        appended to the JSONL log (type ``snapshot``) when a sink is
-        configured."""
+        summary, and the prefix-cache table when the feature is on. Carries
+        ``replica_id`` (engine identity) so a Router's merged fleet view
+        stays attributable. Also appended to the JSONL log (type
+        ``snapshot``) when a sink is configured."""
         from ..comm.logger import comms_logger
 
         extra = {}
@@ -1252,6 +1485,7 @@ class ServingEngine:
         if self._inj is not None:
             extra["fault_injection"] = self._inj.stats()
         snap = self.telemetry.snapshot(
+            replica_id=self.replica_id,
             compiles=self.compile_counts(),
             comm=comms_logger.summary(),
             **extra,

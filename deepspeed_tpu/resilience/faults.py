@@ -30,6 +30,14 @@ Fault sites (see docs/resilience.md for where each is wired):
                       (the device-side sentinel must catch it).
   ``preempt``         simulated preemption before a chosen training step
                       (``PreemptionSignal`` raised pre-dispatch).
+  ``replica_dead``    a serving Router replica dies before a chosen router
+                      step: the replica's scheduler is never stepped again
+                      and its in-flight requests must fail over
+                      (inference/router.py).
+  ``replica_hang``    a replica's step is observed past ``health.timeout``
+                      at a chosen router step (the verdict path — the step
+                      itself completes in-process; the Router treats the
+                      synthetic latency as a hung heartbeat).
 
 Two selection modes compose:
 
@@ -64,7 +72,8 @@ class FaultInjector:
     ``runtime.config.FaultInjectionConfig``, a plain dict with the same
     keys, or None (disabled)."""
 
-    SITES = ("nan_grads", "io_error", "io_flaky", "garbage_logits", "preempt")
+    SITES = ("nan_grads", "io_error", "io_flaky", "garbage_logits", "preempt",
+             "replica_dead", "replica_hang")
 
     def __init__(self, cfg: Any = None):
         self.enabled = bool(_get(cfg, "enabled", False)) if cfg is not None else False
@@ -78,6 +87,12 @@ class FaultInjector:
         self.garbage_logits_phase = str(_get(cfg, "garbage_logits_phase", "decode"))
         self.garbage_logits_decode_step = int(_get(cfg, "garbage_logits_decode_step", 0))
         self.preempt_steps = set(_get(cfg, "preempt_steps", []) or [])
+        # router replica faults: [replica_id, router_step] pairs (1-based
+        # steps, like every other step-keyed list)
+        self.replica_dead_at = {tuple(int(x) for x in p)
+                                for p in _get(cfg, "replica_dead_at", []) or []}
+        self.replica_hang_at = {tuple(int(x) for x in p)
+                                for p in _get(cfg, "replica_hang_at", []) or []}
         self._writes = 0  # guarded-write clock (io_error site)
         self._fired: set = set()  # list-mode keys fire exactly once
         self._lock = threading.Lock()
@@ -164,6 +179,24 @@ class FaultInjector:
         if not self.enabled:
             return False
         return self._fire("preempt", step in self.preempt_steps, step)
+
+    def replica_dead(self, replica: int, step: int) -> bool:
+        """True if Router replica ``replica`` should be found dead before
+        router step ``step`` (1-based)."""
+        if not self.enabled:
+            return False
+        return self._fire("replica_dead",
+                          (replica, step) in self.replica_dead_at,
+                          (replica, step))
+
+    def replica_hang(self, replica: int, step: int) -> bool:
+        """True if replica ``replica``'s router step ``step`` should be
+        observed as hung (step latency past ``health.timeout``)."""
+        if not self.enabled:
+            return False
+        return self._fire("replica_hang",
+                          (replica, step) in self.replica_hang_at,
+                          (replica, step))
 
     def stats(self) -> dict:
         return {

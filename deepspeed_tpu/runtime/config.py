@@ -251,9 +251,13 @@ class FaultInjectionConfig:
       is poisoned so the compiled program genuinely computes NaN logits.
     - ``preempt_steps``: 1-based global steps before which a
       ``PreemptionSignal`` is raised (pre-dispatch: state is checkpointable).
+    - ``replica_dead_at`` / ``replica_hang_at``: ``[replica_id, router_step]``
+      pairs (1-based steps) at which a serving Router replica is found dead
+      before its step, or its step is observed past ``health.timeout``
+      (inference/router.py consumes these; engines ignore them).
     - ``rate`` in [0, 1] with optional ``sites`` allowlist
       (``nan_grads`` | ``io_error`` | ``io_flaky`` | ``garbage_logits`` |
-      ``preempt``).
+      ``preempt`` | ``replica_dead`` | ``replica_hang``).
     """
 
     enabled: bool = False
@@ -267,6 +271,8 @@ class FaultInjectionConfig:
     garbage_logits_phase: str = "decode"
     garbage_logits_decode_step: int = 0
     preempt_steps: list = field(default_factory=list)
+    replica_dead_at: list = field(default_factory=list)
+    replica_hang_at: list = field(default_factory=list)
 
     def __post_init__(self):
         if not 0.0 <= self.rate <= 1.0:
@@ -277,10 +283,18 @@ class FaultInjectionConfig:
                 "fault_injection.garbage_logits_phase must be prefill|decode, "
                 f"got {self.garbage_logits_phase!r}")
         bad = set(self.sites) - {"nan_grads", "io_error", "io_flaky",
-                                 "garbage_logits", "preempt"}
+                                 "garbage_logits", "preempt",
+                                 "replica_dead", "replica_hang"}
         if bad:
             raise DeepSpeedConfigError(
                 f"fault_injection.sites contains unknown site(s) {sorted(bad)}")
+        for name in ("replica_dead_at", "replica_hang_at"):
+            for p in getattr(self, name):
+                if (not isinstance(p, (list, tuple)) or len(p) != 2
+                        or not all(isinstance(x, int) for x in p)):
+                    raise DeepSpeedConfigError(
+                        f"fault_injection.{name} entries must be "
+                        f"[replica_id, router_step] int pairs, got {p!r}")
 
 
 @dataclass
@@ -481,6 +495,80 @@ class ChunkedPrefillConfig:
 
 
 @dataclass
+class RouterHealthConfig:
+    """``serving.router.health`` block (consumed by ``inference/router.py``;
+    docs/serving.md "Multi-replica router").
+
+    - ``timeout``: step-latency heartbeat bound (seconds). A replica whose
+      scheduler step is observed past it gets a HUNG verdict; 0 disables
+      the liveness check (steps are still timed for telemetry).
+    - ``max_attempts`` / ``base_delay_s`` / ``max_delay_s`` / ``jitter``:
+      the probation schedule, field-compatible with ``resilience.retry``'s
+      ``RetryPolicy`` so ``resilience/retry.backoff_delay`` consumes this
+      config directly. A hung replica is re-admitted after the backoff for
+      its verdict count; the ``max_attempts``-th hung verdict escalates to
+      DEAD (detached, like a crashed replica).
+    """
+
+    timeout: float = 5.0
+    max_attempts: int = 3
+    base_delay_s: float = 0.5
+    max_delay_s: float = 8.0
+    jitter: float = 0.25
+
+    def __post_init__(self):
+        if self.timeout < 0:
+            raise DeepSpeedConfigError(
+                f"serving.router.health.timeout must be >= 0, got {self.timeout}")
+        if self.max_attempts < 1:
+            raise DeepSpeedConfigError(
+                f"serving.router.health.max_attempts must be >= 1, "
+                f"got {self.max_attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise DeepSpeedConfigError(
+                "serving.router.health delays must be >= 0")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise DeepSpeedConfigError(
+                f"serving.router.health.jitter must be in [0, 1], "
+                f"got {self.jitter}")
+
+
+@dataclass
+class RouterConfig:
+    """``serving.router`` block (consumed by ``inference/router.Router``;
+    docs/serving.md "Multi-replica router").
+
+    - ``replicas``: ``ServingEngine`` replicas behind the router. 1 keeps
+      the single-engine behavior (the router is then a thin pass-through).
+    - ``affinity``: prefix-affinity dispatch — prefer the replica whose
+      radix trie already holds the longest match of the prompt (stat-free
+      peek), falling back to least-loaded. Only meaningful with
+      ``serving.prefix_cache.enabled``.
+    - ``max_queue_len``: GLOBAL bound on arrived not-yet-admitted requests
+      summed across live replicas; past it ``submit`` raises a typed
+      ``RequestRejected(reason="queue_full")``. 0 = unbounded. Per-replica
+      ``serving.max_queue_len`` still applies underneath.
+    - ``health``: liveness/probation sub-block (its own dataclass above).
+    """
+
+    replicas: int = 1
+    affinity: bool = True
+    max_queue_len: int = 0
+    health: RouterHealthConfig = field(default_factory=RouterHealthConfig)
+
+    def __post_init__(self):
+        if isinstance(self.health, dict):
+            self.health = _build(RouterHealthConfig, self.health)
+        if self.replicas < 1:
+            raise DeepSpeedConfigError(
+                f"serving.router.replicas must be >= 1, got {self.replicas}")
+        if self.max_queue_len < 0:
+            raise DeepSpeedConfigError(
+                f"serving.router.max_queue_len must be >= 0, "
+                f"got {self.max_queue_len}")
+
+
+@dataclass
 class ServingConfig:
     """Serving-engine block (``serving``; consumed by
     ``deepspeed_tpu.inference.ServingEngine``, docs/serving.md).
@@ -516,6 +604,7 @@ class ServingConfig:
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     chunked_prefill: ChunkedPrefillConfig = field(default_factory=ChunkedPrefillConfig)
     fault_injection: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
+    router: RouterConfig = field(default_factory=RouterConfig)
 
     def __post_init__(self):
         if isinstance(self.prefix_cache, dict):
@@ -524,6 +613,8 @@ class ServingConfig:
             self.chunked_prefill = _build(ChunkedPrefillConfig, self.chunked_prefill)
         if isinstance(self.fault_injection, dict):
             self.fault_injection = _build(FaultInjectionConfig, self.fault_injection)
+        if isinstance(self.router, dict):
+            self.router = _build(RouterConfig, self.router)
         if self.watchdog_mode not in ("off", "warn", "raise"):
             raise DeepSpeedConfigError(
                 f"serving.watchdog_mode must be off|warn|raise, "

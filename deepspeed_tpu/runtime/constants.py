@@ -39,6 +39,7 @@ MONITOR_WANDB = "wandb"
 MONITOR_CSV = "csv_monitor"
 TELEMETRY = "telemetry"
 SERVING = "serving"
+SERVING_ROUTER = "router"  # sub-block of SERVING (inference/router.py)
 RESILIENCE = "resilience"
 CURRICULUM_LEARNING = "curriculum_learning"
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"

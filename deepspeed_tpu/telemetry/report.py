@@ -13,6 +13,9 @@ Pretty-prints, for CI logs and bench triage:
     resident entries) when the run's snapshot carries one,
   * the resilience table (``resilience/*`` recovery/degradation counters,
     fault-injector fired/opportunity ratios, non-ok request statuses),
+  * the serving-router table (per-replica health state and
+    dispatched/failed-over/drained/completed counts plus the ``router/*``
+    counters) when the snapshot came from a ``Router``,
   * the last registry ``snapshot`` event, if the run emitted one.
 
 Pure stdlib + host-side: safe to run anywhere the JSONL landed (no jax
@@ -150,6 +153,35 @@ def summarize(events: list[dict], top: int = 10) -> str:
                     f"{e['pool_slot']:>10}")
             if len(entries) > top:
                 lines.append(f"  ... +{len(entries) - top} more entries")
+        lines.append("")
+
+    # -- serving router -------------------------------------------------
+    # per-replica fleet view (inference/router.py telemetry_snapshot):
+    # health state + traffic counts, so a failed-over / drained replica is
+    # visible at a glance in CI logs
+    rt = snap.get("router") if snap is not None else None
+    if rt:
+        reps = rt.get("replicas", {})
+        lines.append(
+            f"serving router ({len(reps)} replicas, "
+            f"{rt.get('steps', 0)} steps, "
+            f"{rt.get('live_requests', 0)} in flight):")
+        lines.append(
+            f"  {'replica':>7} {'state':<10} {'dispatched':>10} "
+            f"{'failed_over':>11} {'drained':>8} {'completed':>10} {'load':>6}")
+        for rid in sorted(reps, key=str):
+            d = reps[rid]
+            lines.append(
+                f"  {rid!s:>7} {d.get('state', '?'):<10} "
+                f"{d.get('dispatched', 0):>10} {d.get('failed_over', 0):>11} "
+                f"{d.get('drained', 0):>8} {d.get('completed', 0):>10} "
+                f"{d.get('load', 0):>6}")
+        cs = {k.split("/", 1)[1]: v
+              for k, v in rt.get("metrics", {}).get("counters", {}).items()
+              if k.startswith("router/")}
+        if cs:
+            lines.append("  " + " ".join(
+                f"{k}={v:g}" for k, v in sorted(cs.items())))
         lines.append("")
 
     # -- resilience -----------------------------------------------------

@@ -131,6 +131,8 @@ def test_1f1b_engine_matches_gpipe():
     assert np.isfinite(l2) and l2 < l_1 + 0.5
 
 
+@pytest.mark.slow  # ~8s warm: the rotary+dp variant of
+# test_1f1b_engine_matches_gpipe, which keeps the 1F1B schedule parity warm
 def test_1f1b_rotary_dp_sharded():
     """positions must be sized for the per-dp-shard microbatch slice inside
     the executor's shard_map (rotary actually consumes them)."""

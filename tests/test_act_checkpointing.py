@@ -41,6 +41,8 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(ref, remat, rtol=2e-5)
 
 
+@pytest.mark.slow  # ~9s warm; the cpu-offload VARIANT of the remat parity
+# — test_remat_matches_no_remat keeps the base act-ckpt parity warm
 def test_cpu_checkpointing_offload_matches():
     """checkpoint_in_cpu (reference :480): boundary residuals in pinned host
     memory — numerics must be identical."""
@@ -62,6 +64,7 @@ def test_partition_activations_matches_on_tp_mesh():
     np.testing.assert_allclose(ref, part, rtol=2e-5)
 
 
+@pytest.mark.slow  # ~8s warm; grouping variant of the same parity family
 def test_number_checkpoints_grouping_matches():
     """num_checkpoints < num_layers: group remat (boundaries saved every
     L/num_checkpoints layers), same math."""

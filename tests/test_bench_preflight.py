@@ -1,0 +1,142 @@
+"""bench.py backend-preflight hardening (ROADMAP item 1, r04/r05 regression).
+
+The contract: a dead TPU tunnel is a RETRIABLE condition (bounded-backoff
+preflight via resilience/retry.py), and every emitted JSON row carries
+``platform`` + a ``comparable`` verdict so a fallback-backend (CPU) row can
+never silently flatline the BENCH trajectory again. Pure host tests — the
+child runner is stubbed; nothing spawns a subprocess or touches jax."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    spec = importlib.util.spec_from_file_location("bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_stamp_row_platform_and_comparable(bench):
+    assert bench._stamp_row({"platform": "tpu"}, "full") == {
+        "platform": "tpu", "bench_stage": "full", "comparable": True}
+    assert bench._stamp_row({"platform": "cpu"}, "cpu_fallback")["comparable"] is False
+    # a row that never ran anywhere stamps platform "none", non-comparable
+    row = bench._stamp_row({}, "none")
+    assert row["platform"] == "none" and row["comparable"] is False
+
+
+def test_preflight_retries_with_bounded_backoff(bench):
+    """Every failed attempt is retried with the resilience/retry backoff:
+    monotone growth, capped, deterministic (same seed -> same delays)."""
+    sleeps, sleeps2 = [], []
+    dead = lambda env, timeout: (None, "timeout")
+    diag = {"preflight": None, "preflight_attempts": 0}
+    up, errs = bench._preflight_probe(dead, 5, 10, diag, sleep=sleeps.append)
+    assert not up and len(errs) == 5
+    assert diag["preflight_attempts"] == 5
+    assert len(sleeps) == 4
+    assert sleeps == sorted(sleeps)  # exponential growth
+    assert all(s <= 120 * 1.25 for s in sleeps)  # max_delay cap (+jitter)
+    bench._preflight_probe(dead, 5, 10,
+                           {"preflight": None, "preflight_attempts": 0},
+                           sleep=sleeps2.append)
+    assert sleeps == sleeps2  # deterministic jitter: CI-reproducible
+
+
+def test_preflight_success_midway_stops_retrying(bench):
+    n = [0]
+
+    def flaky(env, timeout):
+        n[0] += 1
+        if n[0] < 3:
+            return None, "timeout"
+        return json.dumps({"metric": "preflight", "platform": "tpu",
+                           "elapsed_s": 1.0}), None
+
+    diag = {"preflight": None, "preflight_attempts": 0}
+    up, errs = bench._preflight_probe(flaky, 6, 10, diag, sleep=lambda s: None)
+    assert up and len(errs) == 2 and diag["preflight_attempts"] == 3
+    assert diag["preflight"]["platform"] == "tpu"
+
+
+def test_preflight_cpu_comeup_is_retried_like_a_timeout(bench):
+    """A dead tunnel can manifest as a SILENT cpu fallback (jax init falls
+    through instead of raising) — the same retriable condition as a timeout:
+    a later fresh child can find the TPU once the tunnel comes up."""
+    n = [0]
+
+    def late_tunnel(env, timeout):
+        n[0] += 1
+        platform = "cpu" if n[0] < 3 else "tpu"
+        return json.dumps({"metric": "preflight", "platform": platform,
+                           "elapsed_s": 1.0}), None
+
+    diag = {"preflight": None, "preflight_attempts": 0}
+    up, errs = bench._preflight_probe(late_tunnel, 5, 10, diag,
+                                      sleep=lambda s: None)
+    assert up and n[0] == 3 and errs == ["came up on cpu"] * 2
+    # genuinely CPU-only box: every attempt retried, then a clean verdict
+    n[0] = 10**9
+    up, errs = bench._preflight_probe(
+        late_tunnel, 3, 10, {"preflight": None, "preflight_attempts": 0},
+        sleep=lambda s: None)
+    assert up  # 10**9 >= 3 -> tpu; now the all-cpu case:
+    always_cpu = lambda env, timeout: (json.dumps(
+        {"metric": "preflight", "platform": "cpu", "elapsed_s": 1.0}), None)
+    up, errs = bench._preflight_probe(
+        always_cpu, 3, 10, {"preflight": None, "preflight_attempts": 0},
+        sleep=lambda s: None)
+    assert not up and errs == ["came up on cpu"] * 3
+
+
+def test_forced_preflight_failure_emits_non_comparable_row(
+        bench, monkeypatch, capsys):
+    """Acceptance: a forced preflight failure produces a RETRIED,
+    explicitly non-comparable cpu_fallback row with the diagnosis — never a
+    silent CPU datapoint."""
+    monkeypatch.setenv("DSTPU_BENCH_FORCE_PREFLIGHT_FAIL", "1")
+    monkeypatch.setenv("DSTPU_BENCH_PREFLIGHT_ATTEMPTS", "3")
+
+    def fake_child(extra_env, timeout):
+        if extra_env.get("JAX_PLATFORMS") == "cpu":
+            return json.dumps({"metric": "gpt2 tflops", "value": 1.0,
+                               "platform": "cpu"}), None
+        raise AssertionError(f"unexpected child stage: {extra_env}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench._parent() == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    row = json.loads(out[-1])
+    assert row["bench_stage"] == "cpu_fallback"
+    assert row["platform"] == "cpu"
+    assert row["comparable"] is False
+    assert row["preflight_attempts"] == 3  # the tunnel WAS retried
+    assert "preflight failed" in row["diagnosis"]
+
+
+def test_tpu_row_stays_comparable(bench, monkeypatch, capsys):
+    monkeypatch.delenv("DSTPU_BENCH_FORCE_PREFLIGHT_FAIL", raising=False)
+    monkeypatch.setenv("DSTPU_BENCH_PREFLIGHT_ATTEMPTS", "2")
+
+    def fake_child(extra_env, timeout):
+        if extra_env.get(bench._MODE_ENV) == "preflight":
+            return json.dumps({"metric": "preflight", "platform": "tpu",
+                               "elapsed_s": 2.0, "n_chips": 4}), None
+        if extra_env.get(bench._MODE_ENV) == "full":
+            return json.dumps({"metric": "gpt2 tflops", "value": 90.0,
+                               "platform": "tpu"}), None
+        raise AssertionError(f"unexpected child stage: {extra_env}")
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    assert bench._parent() == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["bench_stage"] == "full"
+    assert row["platform"] == "tpu" and row["comparable"] is True
+    assert row["preflight_attempts"] == 1

@@ -105,6 +105,9 @@ def test_bw_calc():
     assert bus == pytest.approx(10.0 * 2 * 7 / 8)
 
 
+@pytest.mark.slow  # ~12s warm; the 1-bit error-feedback path is covered
+# warm end-to-end by test_onebit (adam/lamb convergence-parity + packed-wire
+# tests) — this is the isolated-collective variant of the same contract
 def test_compressed_allreduce_error_feedback(mesh8):
     """1-bit error-feedback allreduce (reference runtime/comm/nccl.py:51):
     per-iteration output is the sign-compressed average; accumulated over K

@@ -43,6 +43,8 @@ def test_dropout_stochastic_in_training_deterministic_at_inference():
     np.testing.assert_array_equal(np.asarray(a), np.asarray(a2))
 
 
+@pytest.mark.slow  # ~9s warm statistical estimator (PR 5 already halved its
+# key count); dropout TRAINS warm via test_dropout_training_loss_differs
 def test_dropout_inverted_scaling_preserves_mean():
     # E[dropout(x)] == x: train many keys, mean approaches deterministic
     cfg = _cfg(hidden_dropout=0.3, num_layers=1)
@@ -90,6 +92,8 @@ def _reset_active_mesh():
     yield
 
 
+@pytest.mark.slow  # ~7s warm; MoE grouped-scan parity — MoE training stays
+# warm in test_moe / test_moe_training_with_remat
 def test_moe_grouped_scan_matches_python_loop():
     cfg = _moe_cfg()
     params = tfm.init(cfg, jax.random.PRNGKey(0))

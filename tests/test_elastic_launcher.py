@@ -424,6 +424,10 @@ def _run_cli(args):
                           capture_output=True, text=True, timeout=120)
 
 
+@pytest.mark.slow  # ~16s warm: every exit-code case is a fresh python
+# child process booting the launcher. The agent-level behavior (heartbeat,
+# membership, relaunch pacing) stays covered warm by the in-process tests
+# in this module; the bin contract runs in the slow tier.
 def test_dstpu_elastic_exit_codes(tmp_path):
     """0 = valid (world compatible), 3 = config rejects world size,
     2 = usage error (missing config). One subprocess per verdict."""

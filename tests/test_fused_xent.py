@@ -65,6 +65,10 @@ def test_fused_xent_grads_match_xla():
     assert np.abs(np.asarray(dhf)[::5]).max() == 0.0
 
 
+@pytest.mark.slow  # ~14s warm (full dp-sharded engine build + train);
+# test_model_loss_impl_fused_matches_chunked and the remaining module tests
+# keep the fused-xent numerics and loss-impl selection covered warm — this
+# is the e2e engine variant of the same contract
 def test_engine_trains_with_fused_loss_dp_sharded():
     """The kernel runs inside the engine's pjit step over a data-sharded
     batch (8 virtual devices; per-shard rows still block-aligned)."""

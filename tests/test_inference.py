@@ -102,7 +102,16 @@ def test_bert_hidden_states_match_hf():
     np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("arch", sorted(CASES))
+@pytest.mark.parametrize(
+    "arch",
+    [a if a != "bloom" else pytest.param(
+        "bloom",
+        # bloom alone is ~25s warm (the torch reference build dominates) —
+        # 3x any sibling; the other five decoder archs keep replace_module
+        # + both rotary/learned position paths covered warm, and the slow
+        # tier keeps the alibi cross-check
+        marks=pytest.mark.slow)
+     for a in sorted(CASES)])
 def test_policy_logits_match_hf(arch):
     hf = CASES[arch]()
     model, params = replace_module(hf_model=hf, dtype=jnp.float32)

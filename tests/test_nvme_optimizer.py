@@ -98,6 +98,8 @@ def test_engine_nvme_offload_trains(tmp_path):
     engine.nvme_opt.close()
 
 
+@pytest.mark.slow  # ~6s warm; nvme tier training/teardown stays warm in the
+# remaining module tests (incl. the handle-close ordering mitigation)
 def test_engine_nvme_checkpoint_resume(tmp_path):
     """Resume contract: load_checkpoint resyncs the NVMe tier's masters to
     the restored weights — the next step must continue from them, not from

@@ -142,6 +142,8 @@ def test_offload_param_trains_and_matches_unoffloaded():
     assert lp[-1] < lp[0]
 
 
+@pytest.mark.slow  # ~6s warm; the gas-accumulation variant — param offload
+# TRAINING parity stays warm in test_offload_param_trains_and_matches
 def test_offload_param_gas_accumulates_on_host():
     """gas > 1: the gradient accumulator lives on the host tier; training
     still converges."""

@@ -176,6 +176,8 @@ def test_onebit_lamb_warmup_and_frozen_train():
     assert np.abs(opt["error"]["flat"]).max() > 0
 
 
+@pytest.mark.slow  # ~10s warm; zoadam phase behavior is also pinned by the
+# lamb two-phase/frozen-wire tests that stay warm
 def test_zoadam_var_and_local_phases():
     """ZeroOneAdam: variance updates ride an exponentially sparsifying grid;
     after var_freeze_step the local-step phase accumulates per-rank deltas in
@@ -234,6 +236,8 @@ def test_zoadam_clock_matches_reference_policy():
         clock.local_interval, clock.local_counter)
 
 
+@pytest.mark.slow  # ~11s warm multi-step convergence compare; warmup-phase
+# parity + the two-phase backend tests keep 1-bit Adam correctness warm
 def test_onebit_adam_convergence_parity_with_adamw():
     """1-bit Adam through warm+frozen phases lands within a loose band of
     dense AdamW on the same stream — compression must not wreck convergence
@@ -315,6 +319,8 @@ def test_zoadam_local_step_has_no_gradient_comm(mesh8):
         jax.tree.leaves(e.state["params"]))), wire_sync
 
 
+@pytest.mark.slow  # ~8s warm; lamb two-phase + frozen-wire tests keep the
+# freeze machinery warm, checkpoint roundtrip is covered in test_engine
 def test_onebit_lamb_checkpoint_resume_keeps_freeze_artifacts(tmp_path):
     """Resuming a frozen-stage OneBitLamb run must restore the warmup-derived
     scaling_coeff / lamb_coeff_freeze / v_fresh from the checkpoint and NOT

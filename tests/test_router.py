@@ -1,0 +1,382 @@
+"""Multi-replica serving router (inference/router.py).
+
+The contract under test: N ServingEngine replicas behind one Router keep
+the single-engine guarantees under replica failure — every accepted
+request reaches a terminal uid (no hangs for direct drivers), completed
+greedy outputs are BIT-IDENTICAL to the unfaulted single-engine run
+(failover replays from scratch on a clean replica), drain loses zero
+accepted requests, the global queue bound sheds with a typed rejection,
+and prefix-affinity routes shared-prefix traffic to the warm replica.
+
+Speed: every test reuses the session-scoped ``tiny_serving_engine``
+fixture and the (n_slots, prompt-length, max_new) combinations existing
+modules already compiled, so the router suite adds NO new XLA program
+shapes — the router is pure host code, and the watchdog's raise mode
+proves it over the failover tests.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import Request, Router
+from deepspeed_tpu.resilience import RequestRejected
+
+# the session-standard feature config (tests/test_prefix_cache.py) — same
+# pool/chunk shapes, same cached programs
+FEATURES = {
+    "prefix_cache": {"enabled": True, "n_slots": 4, "block": 8,
+                     "max_prefix_len": 64},
+    "chunked_prefill": {"enabled": True, "chunk_size": 16},
+}
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_serving_engine):
+    return tiny_serving_engine
+
+
+def _prompts(sizes, seed=0, vocab=97):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, size=s).astype(np.int32) for s in sizes]
+
+
+def _router(engine, n_slots=2, replicas=2, timeout=30.0, fi=None, **extra):
+    cfg = {"n_slots": n_slots, "max_seq_len": 128,
+           "router": {"replicas": replicas, "health": {"timeout": timeout}},
+           **extra}
+    if fi is not None:
+        cfg["fault_injection"] = {"enabled": True, "seed": 0, **fi}
+    return Router(engine, config=cfg)
+
+
+def test_failover_mid_decode_greedy_parity(engine):
+    """replica_dead injected mid-decode: the dead replica's in-flight
+    requests fail over exactly once, every uid reaches a terminal state,
+    and every completed stream is bit-identical to the solo generate —
+    under watchdog RAISE mode (the router added no program shapes)."""
+    prompts = _prompts([5, 11, 23])  # test_serving's parity set
+    refs = [engine.generate(p[None], max_new_tokens=8)[0] for p in prompts]
+    router = _router(engine, fi={"replica_dead_at": [[0, 3]]},
+                     watchdog_mode="raise")
+    res = router.serve([Request(uid=i, prompt=p, max_new_tokens=8)
+                        for i, p in enumerate(prompts)])
+    for i in range(3):
+        assert res[i].ok, (i, res[i].status)
+        np.testing.assert_array_equal(res[i].tokens, refs[i])
+    assert router.replica_states() == {0: "dead", 1: "healthy"}
+    counters = router.telemetry.registry.snapshot()["counters"]
+    assert counters["router/failovers"] >= 1
+    assert counters.get("router/failed_requests", 0) == 0
+    assert router.router_stats()["failovers_recovered"] >= 1
+    # the survivor's decode stayed ONE program under the fault
+    for r in router._replicas:
+        if r.state != "dead":
+            assert r.engine.compile_counts()["decode"] == 1
+
+
+def test_failover_mid_prefill_replays_and_never_stores(engine):
+    """replica_dead while the request is still PREFILLING (chunked): the
+    replay prefills from scratch on the survivor with parity, and the dead
+    replica never prefix_store'd its unverified KV."""
+    rng = np.random.default_rng(21)
+    shared = rng.integers(0, 97, size=40).astype(np.int32)
+    prompt = np.concatenate([shared,
+                             rng.integers(0, 97, size=5).astype(np.int32)])
+    ref = engine.generate(prompt[None], max_new_tokens=6)[0]
+    router = _router(engine, fi={"replica_dead_at": [[0, 2]]},
+                     watchdog_mode="raise", **FEATURES)
+    res = router.serve([Request(uid=0, prompt=prompt, max_new_tokens=6)])
+    assert res[0].ok, res[0].status
+    np.testing.assert_array_equal(res[0].tokens, ref)
+    dead, alive = router._replicas[0], router._replicas[1]
+    assert dead.state == "dead" and dead.engine.prefix_cache_stats()["inserts"] == 0
+    assert alive.engine.prefix_cache_stats()["inserts"] >= 1
+    assert alive.engine.compile_counts()["decode"] == 1
+
+
+def test_drain_under_load_loses_nothing(engine):
+    """drain_replica under a queued backlog: queued requests migrate to the
+    sibling (not failover), in-flight work finishes, the replica detaches,
+    and ALL accepted requests complete with solo-generate parity."""
+    prompts = _prompts([5, 9, 17, 6, 12], seed=2)  # test_slot_reuse's set
+    router = _router(engine)
+    for i, p in enumerate(prompts):
+        router.submit(Request(uid=i, prompt=p, max_new_tokens=4 + i))
+    router.drain_replica(0, block=True)
+    assert router.replica_states()[0] == "drained"
+    res = router.drain()
+    for i, p in enumerate(prompts):
+        assert res[i].ok, (i, res[i].status)
+        np.testing.assert_array_equal(
+            res[i].tokens, engine.generate(p[None], 4 + i)[0])
+    stats = router.router_stats()["replicas"]
+    assert stats[0]["drained"] >= 1  # queued requests really migrated
+    counters = router.telemetry.registry.snapshot()["counters"]
+    assert counters.get("router/failovers", 0) == 0  # drain is not failover
+    # a drained replica never receives new dispatch
+    router.submit(Request(uid=100, prompt=prompts[0], max_new_tokens=2))
+    assert router._owner[100] == 1
+    router.drain()
+    # draining twice is a caller error, typed
+    with pytest.raises(ValueError, match="only a healthy replica"):
+        router.drain_replica(0)
+
+
+def test_global_shed_typed(engine):
+    """The router-level arrived-queue bound raises typed RequestRejected
+    across replicas; the already-accepted backlog still completes."""
+    prompts = _prompts([5, 11, 9], seed=3)
+    router = _router(engine, n_slots=1,
+                     **{"router": {"replicas": 2, "max_queue_len": 2,
+                                   "health": {"timeout": 30.0}}})
+    for i in range(2):
+        router.submit(Request(uid=i, prompt=prompts[i], max_new_tokens=2))
+    with pytest.raises(RequestRejected) as exc:
+        router.submit(Request(uid=2, prompt=prompts[2], max_new_tokens=2))
+    assert exc.value.reason == "queue_full"
+    assert router.telemetry.registry.snapshot()["counters"]["router/shed"] == 1
+    res = router.drain()
+    assert res[0].ok and res[1].ok and 2 not in res
+
+
+def test_exempt_requeue_neither_shed_nor_displaces(engine):
+    """A failover/drain requeue onto a bound-limited replica sits OUTSIDE
+    the queue-bound accounting: _shed_overflow must neither shed the
+    requeued request nor displace an already-accepted arrival (regression:
+    the sweep once counted exempt uids toward the bound)."""
+    from deepspeed_tpu.inference import ServingEngine
+
+    prompts = _prompts([5, 11, 9], seed=8)
+    srv = ServingEngine(engine, n_slots=1, max_seq_len=128,
+                        config={"max_queue_len": 2})
+    srv.submit(Request(uid=0, prompt=prompts[0], max_new_tokens=2))
+    srv.submit(Request(uid=1, prompt=prompts[1], max_new_tokens=2))
+    srv.requeue(Request(uid=2, prompt=prompts[2], max_new_tokens=2))
+    srv.step(now=0.0)  # sweep runs: nothing may be shed
+    res = srv.drain()
+    assert {u for u, r in res.items() if r.status == "shed_queue_full"} == set()
+    assert all(res[u].ok for u in (0, 1, 2)), {u: res[u].status for u in res}
+
+
+def test_prefix_affinity_routes_to_warm_replica(engine):
+    """A shared-prefix request routes to the replica whose trie already
+    holds the prefix — beating the least-loaded rid-0 tiebreak — and the
+    warm replica's hit counters prove the cache actually served it."""
+    rng = np.random.default_rng(30)
+    shared = rng.integers(0, 97, size=24).astype(np.int32)
+    filler = rng.integers(0, 97, size=9).astype(np.int32)
+    router = _router(engine, **FEATURES)
+    router.submit(Request(uid=0, prompt=filler, max_new_tokens=2))  # -> r0
+    warm = Request(uid=1, prompt=np.concatenate([shared, filler[:5]]),
+                   max_new_tokens=2)
+    router.submit(warm)  # -> r1 (least loaded)
+    assert router._owner[1] == 1
+    router.drain()  # r1's trie now holds the shared prefix; both idle
+    router.submit(Request(uid=2, prompt=np.concatenate([shared, filler[:7]]),
+                          max_new_tokens=2))
+    assert router._owner[2] == 1  # affinity won over the rid-0 tiebreak
+    router.drain()
+    assert router._replicas[1].engine.prefix_cache_stats()["hits"] >= 1
+    assert router._replicas[0].engine.prefix_cache_stats()["hits"] == 0
+    counters = router.telemetry.registry.snapshot()["counters"]
+    assert counters["router/affinity_hits"] >= 1
+
+
+def test_hang_probation_backoff_and_readmission(engine):
+    """A hung step-latency verdict fails the work over, parks the replica
+    on retry-backoff probation, and re-admits it once the (deterministic)
+    backoff elapses — after which it serves traffic again."""
+    prompts = _prompts([5, 11])
+    refs = [engine.generate(p[None], max_new_tokens=8)[0] for p in prompts]
+    router = _router(
+        engine, fi={"replica_hang_at": [[0, 2]]},
+        **{"router": {"replicas": 2,
+                      "health": {"timeout": 5.0, "max_attempts": 3,
+                                 "base_delay_s": 1.0, "jitter": 0.0}}})
+    for i, p in enumerate(prompts):
+        router.submit(Request(uid=i, prompt=p, max_new_tokens=8))
+    router.step(now=0.0)
+    router.step(now=0.0)  # injected hang -> verdict
+    assert router.replica_states()[0] == "probation"
+    router.step(now=0.5)
+    assert router.replica_states()[0] == "probation"  # backoff = 1.0s
+    router.step(now=1.5)
+    assert router.replica_states()[0] == "healthy"
+    res = router.drain()
+    for i in range(2):
+        assert res[i].ok, (i, res[i].status)
+        np.testing.assert_array_equal(res[i].tokens, refs[i])
+    counters = router.telemetry.registry.snapshot()["counters"]
+    assert counters["router/hung_verdicts"] == 1
+    assert counters["router/readmissions"] == 1
+    # the re-admitted replica accepts dispatch again (rid-0 tiebreak)
+    router.submit(Request(uid=50, prompt=prompts[0], max_new_tokens=2))
+    assert router._owner[50] == 0
+    router.drain()
+
+
+def test_hang_escalates_to_dead_after_max_attempts(engine):
+    """health.max_attempts = 1: the first hung verdict has no probation
+    budget left and escalates straight to dead."""
+    (p,) = _prompts([5])
+    ref = engine.generate(p[None], max_new_tokens=8)[0]
+    router = _router(
+        engine, fi={"replica_hang_at": [[0, 1]]},
+        **{"router": {"replicas": 2,
+                      "health": {"timeout": 5.0, "max_attempts": 1}}})
+    router.submit(Request(uid=0, prompt=p, max_new_tokens=8))
+    router.step(now=0.0)
+    assert router.replica_states()[0] == "dead"
+    res = router.drain()
+    assert res[0].ok
+    np.testing.assert_array_equal(res[0].tokens, ref)
+    assert router.telemetry.registry.snapshot()["counters"][
+        "router/replicas_dead"] == 1
+
+
+def test_second_replica_failure_is_failed_replica(engine):
+    """Exactly-once failover: a request whose replay hits a SECOND dead
+    replica is failed with typed terminal status failed_replica — returned
+    from step() like any terminal, never re-bounced to the third replica."""
+    (p,) = _prompts([5])
+    router = _router(engine, replicas=3,
+                     fi={"replica_dead_at": [[0, 2], [1, 4]]})
+    router.submit(Request(uid=0, prompt=p, max_new_tokens=8))
+    terminal = []
+    for _ in range(8):
+        terminal += router.step(now=0.0)
+        if 0 in terminal:
+            break
+    assert 0 in terminal  # the terminal-uid contract held across failures
+    res = router.results[0]
+    assert res.status == "failed_replica"
+    counters = router.telemetry.registry.snapshot()["counters"]
+    assert counters["router/failovers"] == 1
+    assert counters["router/failed_requests"] == 1
+    assert router.replica_states()[2] == "healthy"  # never received the uid
+
+
+def test_snapshot_attribution_and_report_table(engine, tmp_path):
+    """Fleet snapshots stay attributable: per-replica snapshots carry
+    replica_id, registries are nested (no counter-name collisions), and the
+    report CLI renders the per-replica router table from the JSONL log."""
+    from deepspeed_tpu.inference import ServingEngine
+    from deepspeed_tpu.telemetry.report import load_events, summarize
+
+    jsonl = tmp_path / "router.jsonl"
+    prompts = _prompts([5, 11], seed=4)
+    router = _router(engine, jsonl_path=str(jsonl))
+    router.submit(Request(uid=0, prompt=prompts[0], max_new_tokens=2))
+    # the live-requests gauge tracks submissions, not just fault events
+    assert router.telemetry.registry.snapshot()["gauges"][
+        "router/live_requests"] == 1
+    router.submit(Request(uid=1, prompt=prompts[1], max_new_tokens=2))
+    router.drain()
+    snap = router.telemetry_snapshot()
+    assert snap["replicas"][0]["replica_id"] == 0
+    assert snap["replicas"][1]["replica_id"] == 1
+    # per-replica registries are SEPARATE objects: each replica reports its
+    # own decode_steps under the same counter name without summing
+    for rid in (0, 1):
+        assert "metrics" in snap["replicas"][rid]
+    table = snap["router"]["replicas"]
+    assert set(table) == {0, 1}
+    assert sum(r["dispatched"] for r in table.values()) == 2
+    out = summarize(load_events(str(jsonl)))
+    assert "serving router (2 replicas" in out
+    assert "dispatched" in out and "healthy" in out
+    # a solo engine's snapshot carries its identity too
+    solo = ServingEngine(engine, n_slots=2, max_seq_len=128,
+                         config={"replica_id": "solo"})
+    assert solo.telemetry_snapshot()["replica_id"] == "solo"
+
+
+def test_heartbeat_exempts_compiling_steps(engine):
+    """A step that paid a compilation is never a hung verdict — a cold
+    replica's first step compiles for tens of seconds on real hardware, and
+    failing it over would burn exactly-once budgets on healthy machines.
+    A warm step past the timeout still draws the verdict."""
+    p = _prompts([5, 11], seed=13)
+    router = _router(engine, replicas=2,
+                     **{"router": {"replicas": 2,
+                                   "health": {"timeout": 1e-9,
+                                              "max_attempts": 3,
+                                              "base_delay_s": 1.0,
+                                              "jitter": 0.0}}})
+    for i in range(2):  # one per replica: both first steps dispatch
+        router.submit(Request(uid=i, prompt=p[i], max_new_tokens=4))
+    router.step(now=0.0)  # compiles prefill+decode on fresh jit objects
+    # with a 1ns timeout only the compile exemption can keep them healthy
+    assert router.replica_states() == {0: "healthy", 1: "healthy"}
+    router.health.timeout = 30.0  # warm steps are ms-scale; finish the work
+    res = router.drain()
+    for i in range(2):
+        assert res[i].ok
+        np.testing.assert_array_equal(
+            res[i].tokens, engine.generate(p[i][None], 4)[0])
+    # the genuine warm-step verdict path is pinned by the replica_hang tests
+
+
+def test_cancel_duplicate_uid_and_drain_edge_cases(engine):
+    """Review-hardening regressions: (a) a cancelled uid still comes back
+    from the next step() (lifted terminal-uid contract); (b) duplicate uids
+    are rejected fleet-wide, not just per replica; (c) drain migration
+    never targets a replica that already held the uid; (d) a hung verdict
+    on a DRAINING replica escalates to dead instead of probation-then-
+    healthy (a replica being retired must not rejoin dispatch)."""
+    prompts = _prompts([5, 11], seed=12)
+    # (a) + (b)
+    router = _router(engine)
+    router.submit(Request(uid=0, prompt=prompts[0], max_new_tokens=8))
+    with pytest.raises(ValueError, match="unique per router"):
+        router.submit(Request(uid=0, prompt=prompts[1], max_new_tokens=2))
+    assert router.cancel(0)
+    assert router.results[0].status == "cancelled"
+    assert 0 in router.step(now=0.0)  # cancel's uid rides the next step
+    # (c) drain leaves the request in place when the only sibling saw it
+    router = _router(engine)
+    router.submit(Request(uid=0, prompt=prompts[0], max_new_tokens=2))
+    router._seen[0].add(1)  # as if replica 1 held uid 0 in a past failover
+    router.drain_replica(0, block=True)
+    assert router.results[0].ok  # finished on the draining replica
+    assert router.router_stats()["replicas"][0]["drained"] == 0
+    assert router.replica_states()[0] == "drained"
+    # (d) hung while draining -> dead, work fails over, never re-admitted
+    router = _router(engine, fi={"replica_hang_at": [[0, 2]]},
+                     **{"router": {"replicas": 2,
+                                   "health": {"timeout": 5.0,
+                                              "max_attempts": 3}}})
+    router.submit(Request(uid=0, prompt=prompts[0], max_new_tokens=8))
+    router.step(now=0.0)               # admits on replica 0
+    router._replicas[0].state = "draining"  # operator starts the drain
+    router.step(now=0.0)               # injected hang -> verdict
+    assert router.replica_states()[0] == "dead"
+    res = router.drain()
+    assert res[0].ok
+    np.testing.assert_array_equal(
+        res[0].tokens, engine.generate(prompts[0][None], 8)[0])
+
+
+def test_router_config_schema_roundtrip():
+    """serving.router parses through the typed config tree (host-only)."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig.from_dict({
+        "train_batch_size": 1,
+        "serving": {"n_slots": 4,
+                    "router": {"replicas": 3, "affinity": False,
+                               "max_queue_len": 64,
+                               "health": {"timeout": 2.5, "max_attempts": 2}}},
+    })
+    rc = cfg.serving.router
+    assert (rc.replicas, rc.affinity, rc.max_queue_len) == (3, False, 64)
+    assert (rc.health.timeout, rc.health.max_attempts) == (2.5, 2)
+    from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+    with pytest.raises(DeepSpeedConfigError, match="replicas must be >= 1"):
+        DeepSpeedConfig.from_dict({
+            "train_batch_size": 1,
+            "serving": {"router": {"replicas": 0}}})
+    with pytest.raises(DeepSpeedConfigError, match="int pairs"):
+        DeepSpeedConfig.from_dict({
+            "train_batch_size": 1,
+            "serving": {"fault_injection": {"replica_dead_at": [[0]]}}})

@@ -348,10 +348,13 @@ def test_serving_watchdog_raises_on_forced_decode_recompile(inf_engine):
         srv.submit(r)
     srv.drain()  # one decode compile: fine
     assert srv.compile_counts()["decode"] == 1
-    srv._rng, k = jax.random.split(srv._rng)
+    # reach through the scheduler/worker boundary: the WORKER owns the
+    # compiled decode program and device cache
+    w = srv.worker
+    w._rng, k = jax.random.split(w._rng)
     with pytest.raises(RecompileError, match="serving/decode"):
-        srv._decode(
-            srv.params, srv._cache,
+        w._decode(
+            w.params, w._cache,
             jnp.asarray(srv._last_tok, jnp.int16),  # drifted operand dtype
             jnp.asarray(srv._pos), jnp.asarray(srv._active), k,
             jnp.asarray(srv._temp), jnp.asarray(srv._top_k),

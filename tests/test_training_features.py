@@ -146,6 +146,8 @@ def test_eigenvalue_power_iteration_quadratic():
     np.testing.assert_allclose(eigs, [1.0, 4.0, 9.0], rtol=1e-2)
 
 
+@pytest.mark.slow  # ~6s warm; eigenvalue power iteration on the transformer
+# — the small-model eigenvalue tests keep the feature covered warm
 def test_eigenvalue_on_transformer_runs():
     from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
 

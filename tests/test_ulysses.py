@@ -74,6 +74,8 @@ def test_ulysses_rejects_indivisible_heads(ctx_mesh):
         ulysses_attention_sharded(q, k, v, mesh=ctx_mesh)
 
 
+@pytest.mark.slow  # ~10s warm e2e engine train; test_ulysses_grads_match_dense
+# + the forward-parity tests keep the ulysses numerics covered warm
 def test_ulysses_in_model_training(ctx_mesh):
     """End-to-end: transformer with attn_impl='ulysses' trains on a context
     mesh and matches the dense-attention model's losses."""
