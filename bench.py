@@ -209,6 +209,29 @@ def main():
         "compile_s": round(compile_s, 1),
         "config": "fallback_save_flash_micro32" if fallback else "tuned_r5_dots_and_flash_micro16_chunk256",
     }
+    # program-ledger stamp (telemetry/program_ledger.py): XLA's own cost
+    # model for the compiled train step + the derived MFU and roofline
+    # verdict, so each BENCH row carries WHY, not just how fast. Outside
+    # the timed region; on a CPU fallback the row stays labeled
+    # "unrated:cpu" — never rated against a TPU peak (mfu null).
+    try:
+        snap = engine.telemetry_snapshot()
+        rows = snap.get("program_ledger", [])
+        out["program_ledger"] = [
+            {k: row.get(k) for k in
+             ("name", "flops", "bytes_accessed", "arith_intensity",
+              "compile_s", "wall_p50_s", "achieved_tflops", "roofline")}
+            for row in rows[:4]]
+        step_row = next((r for r in rows
+                         if r["name"].startswith("train/train_step")), None)
+        if step_row is not None:
+            out["mfu"] = step_row.get("mfu")
+            out["roofline"] = step_row.get("roofline")
+        hbm = snap.get("hbm", {})
+        if hbm.get("pools"):
+            out["hbm_pools_bytes"] = hbm["pools"]
+    except Exception as e:  # noqa: BLE001 — the throughput row must emit
+        out["program_ledger_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out), flush=True)
     sys.stdout.flush()
     os._exit(0)  # plugin background threads can hang interpreter teardown
@@ -284,6 +307,8 @@ def _fault_smoke(rate: float) -> int:
         # CPU-pinned correctness smoke: never a trajectory datapoint
         "platform": "cpu",
         "comparable": False,
+        "mfu": None,
+        "roofline": "unrated:cpu",
         "fault_rate": rate,
         "n_requests": len(reqs),
         "statuses": dict(statuses),
@@ -423,6 +448,8 @@ def _chaos(steps: int, seed: int) -> int:
         # CPU-pinned correctness soak: never a trajectory datapoint
         "platform": "cpu",
         "comparable": False,
+        "mfu": None,
+        "roofline": "unrated:cpu",
         "target_steps": steps,
         "survivor_steps": survivor_steps,
         "generations": generations,
@@ -443,11 +470,17 @@ def _stamp_row(obj, stage):
     ``comparable`` verdict — False when the row ran on a fallback backend
     (CPU), so the BENCH trajectory tooling can exclude it instead of
     silently flatlining on it (the r04/r05 regression). Rows that never ran
-    anywhere (total failure) stamp platform "none"."""
+    anywhere (total failure) stamp platform "none". The same discipline
+    extends to the perf-xray fields: every row carries ``mfu`` and
+    ``roofline`` keys — null / "unrated:<platform>" unless the child
+    computed real ones from the program ledger, so a fallback row is
+    labeled, never rated against a TPU peak."""
     obj["bench_stage"] = stage
     platform = obj.get("platform") or "none"
     obj["platform"] = platform
     obj["comparable"] = platform not in ("none", "cpu")
+    obj.setdefault("mfu", None)
+    obj.setdefault("roofline", f"unrated:{platform}")
     return obj
 
 

@@ -66,9 +66,9 @@ import numpy as np
 
 from ..resilience import FaultInjector, RequestRejected
 from ..resilience.retry import backoff_delay
-from ..runtime.config import (FaultInjectionConfig, RouterConfig,
-                              RouterHealthConfig)
-from ..telemetry import Telemetry
+from ..runtime.config import (FaultInjectionConfig, RequestTraceConfig,
+                              RouterConfig, RouterHealthConfig)
+from ..telemetry import RequestTracer, Telemetry
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
 from .serving import Request, RequestResult, ServingEngine
@@ -149,6 +149,17 @@ class Router:
             watchdog_mode=config.get("watchdog_mode", "warn"),
         )
         self._epoch = time.perf_counter()
+        # fleet-level request tracing: the router records the dispatch /
+        # failover edges (each replica keeps its own per-stage timeline);
+        # a merged view carries BOTH replica ids across a failover
+        # (telemetry/request_trace.request_timeline)
+        rt = config.get("request_trace", {})
+        if isinstance(rt, dict):
+            rt = RequestTraceConfig(**rt)
+        self.tracer: Optional[RequestTracer] = (
+            RequestTracer(rt.capacity, replica_id="router",
+                          clock=lambda: time.perf_counter() - self._epoch)
+            if rt.enabled else None)
         sub = dict(config)
         # ONE sink at the router — N replicas appending to one JSONL path
         # would interleave half-written lines
@@ -235,6 +246,8 @@ class Router:
         self._seen.setdefault(uid, set()).add(target.rid)
         target.dispatched += 1
         tm.counter("router/dispatched").inc()
+        if self.tracer is not None:
+            self.tracer.record(uid, "dispatched", to_replica=target.rid)
         self._update_gauges()
         return uid
 
@@ -284,7 +297,8 @@ class Router:
         })
         return res
 
-    def _failover(self, req: Request, terminal: list) -> None:
+    def _failover(self, req: Request, terminal: list,
+                  from_rid: int | None = None) -> None:
         """Re-dispatch one request off a failed replica — exactly once per
         uid, never back to a replica that already held it."""
         tm = self.telemetry
@@ -298,6 +312,9 @@ class Router:
             self._synth_result(req, "failed_replica")
             terminal.append(req.uid)
             tm.counter("router/failed_requests").inc()
+            if self.tracer is not None:
+                self.tracer.record(req.uid, "failover", from_replica=from_rid,
+                                   outcome="failed_replica")
             log_dist(
                 f"router: request {req.uid} failed_replica "
                 f"({'failover already spent' if n >= 1 else 'no clean replica left'})",
@@ -310,6 +327,12 @@ class Router:
         seen.add(tgt.rid)
         tgt.dispatched += 1
         tm.counter("router/failovers").inc()
+        if self.tracer is not None:
+            # the one edge that spans replicas: BOTH ids on one event, so a
+            # merged timeline shows the request leaving the dead replica
+            # and re-entering the clean one
+            self.tracer.record(req.uid, "failover", from_replica=from_rid,
+                               to_replica=tgt.rid)
 
     def _fail(self, r: _Replica, verdict: str, now: float,
               terminal: list) -> None:
@@ -354,7 +377,7 @@ class Router:
                 r.engine.cancel(req.uid)
         r.failed_over += len(live)
         for req in live:
-            self._failover(req, terminal)
+            self._failover(req, terminal, from_rid=r.rid)
         self._update_gauges()
 
     def _update_gauges(self) -> None:
@@ -578,6 +601,8 @@ class Router:
             "router": {
                 "metrics": self.telemetry.registry.snapshot(),
                 **self.router_stats(),
+                **({"request_trace": self.tracer.events()}
+                   if self.tracer is not None else {}),
             },
             "replicas": {r.rid: r.engine.telemetry_snapshot()
                          for r in self._replicas},

@@ -106,8 +106,9 @@ from ..models import transformer as tfm
 from ..parallel.sharding import kv_prefix_pool_spec, kv_slot_cache_spec
 from ..resilience import FaultInjector, RequestRejected
 from ..runtime.config import (ChunkedPrefillConfig, FaultInjectionConfig,
-                              PrefixCacheConfig)
-from ..telemetry import Telemetry
+                              LedgerConfig, PrefixCacheConfig,
+                              RequestTraceConfig)
+from ..telemetry import RequestTracer, Telemetry, hbm_snapshot, tree_bytes
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
 from .prefix_cache import PrefixIndex
@@ -537,11 +538,25 @@ class SlotWorker:
                     for kv in ("k", "v")
                 }
 
-            self._poison = jax.jit(fill, donate_argnums=(0,),
-                                   out_shardings=self._cache_shardings)
+            wd = self.telemetry.watchdog
+            self._poison = wd.watch(
+                jax.jit(fill, donate_argnums=(0,),
+                        out_shardings=self._cache_shardings),
+                wd.unique_name("serving/fill_slot"), stable=True)
         self._cache = self._poison(
             self._cache, jnp.int32(slot),
             jnp.asarray(value, self._cache["k"].dtype))
+
+    def hbm_pools(self) -> dict:
+        """Named device-memory pools this worker holds — the HBM ledger's
+        rows (bytes from array metadata, no device sync)."""
+        pools = {
+            "params": tree_bytes(self.params),
+            "slot_kv_cache": tree_bytes(self._cache),
+        }
+        if self._pool is not None:
+            pools["prefix_pool"] = tree_bytes(self._pool)
+        return pools
 
     def compile_counts(self) -> dict:
         """How many XLA programs this worker traced — the continuous-batching
@@ -559,6 +574,8 @@ class SlotWorker:
             out["prefix_fetch"] = int(self._fetch._cache_size())
         if self._store is not None:
             out["prefix_store"] = int(self._store._cache_size())
+        if self._poison is not None:
+            out["fill_slot"] = int(self._poison._cache_size())
         return out
 
 
@@ -636,10 +653,29 @@ class ServingEngine:
         min_prefill_bucket = (min_prefill_bucket if min_prefill_bucket is not None
                               else config.get("min_prefill_bucket", 16))
         seed = seed if seed is not None else config.get("seed", 0)
+        lc = config.get("ledger", {})
+        if isinstance(lc, dict):
+            lc = LedgerConfig(**lc)
+        self.ledger_cfg: LedgerConfig = lc
+        rt = config.get("request_trace", {})
+        if isinstance(rt, dict):
+            rt = RequestTraceConfig(**rt)
         self.telemetry = telemetry if telemetry is not None else Telemetry(
             jsonl_path=config.get("jsonl_path", ""),
             watchdog_mode=config.get("watchdog_mode", "warn"),
+            ledger=lc.enabled,
         )
+        # program-ledger join rules (telemetry/program_ledger.py): each
+        # program family reads its measured wall time from its existing
+        # latency histogram; decode — the steady-state path — nominates the
+        # engine's headline serving/mfu gauge
+        self.telemetry.ledger.bind(
+            "serving/decode", wall_hist="serving/decode_step_sec",
+            gauge="serving")
+        self.telemetry.ledger.bind(
+            "serving/prefill[", wall_hist="serving/prefill_sec")
+        self.telemetry.ledger.bind(
+            "serving/chunk_prefill[", wall_hist="serving/chunk_prefill_sec")
         pc = prefix_cache if prefix_cache is not None else config.get("prefix_cache", {})
         if isinstance(pc, dict):
             pc = PrefixCacheConfig(**pc)
@@ -733,6 +769,13 @@ class ServingEngine:
         # step; skip it entirely until some live request can actually expire
         self._deadlines_armed = self.default_deadline_s > 0
         self._epoch = time.perf_counter()
+        # per-request lifecycle tracing (telemetry/request_trace.py): a
+        # bounded ring of host-side timeline events on the engine's clock,
+        # stamped with this replica's id for fleet-wide merges
+        self.tracer: Optional[RequestTracer] = (
+            RequestTracer(rt.capacity, replica_id=self.replica_id,
+                          clock=lambda: time.perf_counter() - self._epoch)
+            if rt.enabled else None)
         feat = []
         if pc.enabled:
             feat.append(f"prefix_cache[{pc.n_slots}x{self.worker.pmax}, "
@@ -822,6 +865,11 @@ class ServingEngine:
         if request.deadline_s > 0:
             self._deadlines_armed = True
         self._queue.append(request)
+        if self.tracer is not None:
+            # a future-dated request's timeline starts at its logical
+            # arrival instant, matching every other arrival-relative timing
+            self.tracer.record(request.uid, "arrived", t=request.arrival_time,
+                               prompt_len=int(np.asarray(request.prompt).shape[-1]))
         return request.uid
 
     # -- router-facing surface (inference/router.py) --------------------
@@ -835,10 +883,13 @@ class ServingEngine:
         may transiently overshoot by the number of in-flight failovers."""
         self._exempt_uids.add(int(request.uid))
         try:
-            return self.submit(request)
+            uid = self.submit(request)
         except BaseException:
             self._exempt_uids.discard(int(request.uid))
             raise
+        if self.tracer is not None:
+            self.tracer.record(uid, "requeued")
+        return uid
 
     def withdraw(self, uid: int) -> Optional[Request]:
         """Silently remove a still-QUEUED request and hand it back (no
@@ -975,6 +1026,8 @@ class ServingEngine:
             tm.counter("serving/admissions").inc()
             tm.histogram("serving/queue_wait_sec").observe(
                 max(t_adm - req.arrival_time, 0.0))
+            if self.tracer is not None:
+                self.tracer.record(req.uid, "admitted", t=t_adm, slot=slot)
 
             entry = None
             if self._pfx is not None:
@@ -987,6 +1040,9 @@ class ServingEngine:
                     tm.counter("serving/prefix_hits").inc()
                     tm.counter("serving/prefix_tokens_reused").inc(entry.length)
                     self.worker.prefix_fetch(entry.pool_slot, slot)
+                    if self.tracer is not None:
+                        self.tracer.record(req.uid, "prefix_hit",
+                                           tokens=entry.length)
                 else:
                     tm.counter("serving/prefix_misses").inc()
             P = entry.length if entry is not None else 0
@@ -1029,6 +1085,9 @@ class ServingEngine:
         start, width, live = pf.segments[pf.idx]
         toks = np.zeros((1, width), np.int32)
         toks[0, :live] = pf.prompt[start:start + live]
+        if self.tracer is not None:
+            self.tracer.record(pf.req.uid, "chunk", k=pf.idx, width=width,
+                               slot=slot)
         pf.idx += 1
         out = self.worker.chunk(
             width, toks, slot, start, live, pf.req.temperature,
@@ -1089,6 +1148,8 @@ class ServingEngine:
         self._temp[slot] = req.temperature
         self._top_k[slot] = req.top_k
         self._top_p[slot] = req.top_p
+        if self.tracer is not None:
+            self.tracer.record(req.uid, "first_token", t=t_first, slot=slot)
         if self._pfx is not None:
             self._insert_prefix(slot, prompt)
         if first == st.eos or st.remaining <= 0:
@@ -1151,6 +1212,9 @@ class ServingEngine:
             "arrival_s": res.arrival_time, "finish_s": res.finish_time,
             "prefix_hit_tokens": res.prefix_hit_tokens,
         })
+        if self.tracer is not None:
+            self.tracer.record(res.uid, "terminal", t=res.finish_time,
+                               status=status, n_tokens=int(len(res.tokens)))
         self._release_slot(slot)
 
     def _release_slot(self, slot: int):
@@ -1193,6 +1257,9 @@ class ServingEngine:
             "prompt_len": res.prompt_len, "n_tokens": 0, "status": status,
             "arrival_s": req.arrival_time, "finish_s": now,
         })
+        if self.tracer is not None:
+            self.tracer.record(req.uid, "terminal", t=now, status=status,
+                               n_tokens=0)
         return res
 
     # -- degradation paths (docs/resilience.md) -------------------------
@@ -1298,6 +1365,8 @@ class ServingEngine:
         out of rotation (suspect lane), never the last healthy one."""
         tm = self.telemetry
         tm.counter("resilience/quarantines").inc()
+        if self.tracer is not None:
+            self.tracer.record(req.uid, "quarantine", phase=phase, slot=slot)
         # scrub before the slot can be reused: NaN KV anywhere in the row
         # poisons later occupants through masked attention (see SlotWorker.fill_slot)
         self.worker.fill_slot(slot, 0.0)
@@ -1472,11 +1541,14 @@ class ServingEngine:
     def telemetry_snapshot(self) -> dict:
         """ONE call that reports everything: the metrics registry (TTFT/TPOT/
         queue/occupancy histograms, admission/eviction/token counters), the
-        recompile table, the XLA program counts, the trace-time collective
-        summary, and the prefix-cache table when the feature is on. Carries
-        ``replica_id`` (engine identity) so a Router's merged fleet view
-        stays attributable. Also appended to the JSONL log (type
-        ``snapshot``) when a sink is configured."""
+        recompile table, the XLA program counts, the program ledger (per-
+        program flops/bytes/HBM + derived MFU and roofline verdict), the
+        HBM memory ledger (params / slot KV / prefix pool), the per-request
+        timeline buffer, the trace-time collective summary, and the
+        prefix-cache table when the feature is on. Carries ``replica_id``
+        (engine identity) so a Router's merged fleet view stays
+        attributable. Also appended to the JSONL log (type ``snapshot``)
+        when a sink is configured."""
         from ..comm.logger import comms_logger
 
         extra = {}
@@ -1484,10 +1556,14 @@ class ServingEngine:
             extra["prefix_cache"] = self._pfx.stats()
         if self._inj is not None:
             extra["fault_injection"] = self._inj.stats()
+        if self.tracer is not None:
+            extra["request_trace"] = self.tracer.events()
         snap = self.telemetry.snapshot(
             replica_id=self.replica_id,
             compiles=self.compile_counts(),
             comm=comms_logger.summary(),
+            hbm=hbm_snapshot(self.worker.hbm_pools(),
+                             self.ledger_cfg.hbm_warn_fraction),
             **extra,
         )
         self.telemetry.emit({"type": "snapshot", **snap})

@@ -152,6 +152,11 @@ class ProfileResult:
     by_primitive: dict[str, float]
     xla_flops: Optional[float] = None
     by_scope: dict[str, float] = field(default_factory=dict)
+    # full XLA cost/memory view from the shared ledger path
+    # (telemetry/program_ledger.aot_cost): bytes_accessed, argument/output/
+    # temp bytes, arithmetic intensity inputs — same fields the program
+    # ledger reports for the engines' compiled inventories
+    xla_cost: dict = field(default_factory=dict)
 
     @property
     def tflops_per_sec(self) -> Optional[float]:
@@ -180,17 +185,20 @@ class FlopsProfiler:
         if params is not None:
             n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
 
-        xla_flops = None
-        latency = None
+        # XLA cross-check through the SHARED AOT cost path — the same
+        # lower().compile() capture the program ledger uses (and the same
+        # jax-version cost_analysis shim, utils/jax_compat), so the two
+        # never disagree on how to read XLA's cost model. The compile is
+        # served from the compilation cache when the program already ran.
+        from ...telemetry.program_ledger import aot_cost
+
         jitted = jax.jit(fn)
+        latency = None
         try:
-            compiled = jitted.lower(*args).compile()
-            ca = compiled.cost_analysis()
-            if ca:
-                ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-                xla_flops = float(ca.get("flops", 0.0)) or None
-        except Exception:
-            pass
+            xla_cost = aot_cost(jitted, args)
+        except Exception:  # noqa: BLE001 — profiling must not raise
+            xla_cost = {}
+        xla_flops = xla_cost.get("flops")
         if time_it:
             out = jitted(*args)
             jax.block_until_ready(out)
@@ -198,7 +206,8 @@ class FlopsProfiler:
             out = jitted(*args)
             jax.block_until_ready(out)
             latency = time.perf_counter() - t0
-        return ProfileResult(flops, n_params, latency, by_prim, xla_flops, by_scope)
+        return ProfileResult(flops, n_params, latency, by_prim, xla_flops,
+                             by_scope, xla_cost=xla_cost)
 
     def print_model_profile(self, res: ProfileResult, detailed: bool = True,
                             depth: int = -1, top_modules: int = 0, output_file=None):
@@ -215,6 +224,12 @@ class FlopsProfiler:
         ]
         if res.xla_flops:
             lines.append(f"fwd FLOPs (XLA):      {_num(res.xla_flops, 'FLOPs')}")
+        if res.xla_cost.get("bytes_accessed"):
+            by = res.xla_cost["bytes_accessed"]
+            lines.append(f"bytes accessed (XLA): {_num(by, 'B')}")
+            if res.xla_flops:
+                lines.append(
+                    f"arith intensity:      {res.xla_flops / by:.2f} FLOPs/B")
         if res.latency_s:
             lines.append(f"latency:              {res.latency_s*1e3:.2f} ms")
             lines.append(f"achieved:             {res.tflops_per_sec:.2f} TFLOPS")

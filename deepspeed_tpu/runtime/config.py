@@ -192,6 +192,52 @@ class MonitorBackendConfig:
 
 
 @dataclass
+class LedgerConfig:
+    """Program-ledger sub-block (``telemetry.ledger``;
+    ``telemetry/program_ledger.py``, docs/PERF.md):
+
+    - ``enabled``: capture the XLA cost model (flops, bytes accessed, HBM
+      footprint) of every watchdog-wrapped program and derive MFU/roofline
+      rows in ``telemetry_snapshot()``. Capture is host-side spec
+      extraction; the XLA analysis is lazy (first snapshot) and served from
+      the compilation cache — no new program shapes, no hot-path cost.
+    - ``hbm_warn_fraction``: the HBM ledger flags the snapshot when device
+      bytes-in-use exceeds this fraction of the backend's memory limit.
+    """
+
+    enabled: bool = True
+    hbm_warn_fraction: float = 0.9
+
+    def __post_init__(self):
+        if not (0.0 < self.hbm_warn_fraction <= 1.0):
+            raise DeepSpeedConfigError(
+                f"telemetry.ledger.hbm_warn_fraction must be in (0, 1], "
+                f"got {self.hbm_warn_fraction}")
+
+
+@dataclass
+class RequestTraceConfig:
+    """Per-request lifecycle tracing sub-block (``telemetry.request_trace``;
+    ``telemetry/request_trace.py``, docs/observability.md):
+
+    - ``enabled``: record arrived/admitted/chunk/first_token/terminal (and
+      quarantine/failover) timeline events per request — host-side dict
+      appends into a bounded ring buffer.
+    - ``capacity``: ring-buffer size in EVENTS (oldest evicted first).
+      A request produces ~5 events plus one per prefill chunk.
+    """
+
+    enabled: bool = True
+    capacity: int = 2048
+
+    def __post_init__(self):
+        if self.capacity < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.request_trace.capacity must be >= 1, "
+                f"got {self.capacity}")
+
+
+@dataclass
 class TelemetryConfig:
     """Unified telemetry block (``deepspeed_tpu/telemetry/``; docs/observability.md).
 
@@ -213,6 +259,10 @@ class TelemetryConfig:
       async dispatch, profiling runs only.
     - ``monitor_bridge``: forward registry snapshots into the MonitorMaster
       backends at each print boundary.
+    - ``ledger``: program-ledger sub-block (cost model + MFU/roofline;
+      its own dataclass above).
+    - ``request_trace``: per-request lifecycle tracing sub-block (serving
+      engines; its own dataclass above).
     """
 
     enabled: bool = False
@@ -220,8 +270,14 @@ class TelemetryConfig:
     watchdog: str = "warn"
     device_sync_spans: bool = False
     monitor_bridge: bool = True
+    ledger: LedgerConfig = field(default_factory=LedgerConfig)
+    request_trace: RequestTraceConfig = field(default_factory=RequestTraceConfig)
 
     def __post_init__(self):
+        if isinstance(self.ledger, dict):
+            self.ledger = _build(LedgerConfig, self.ledger)
+        if isinstance(self.request_trace, dict):
+            self.request_trace = _build(RequestTraceConfig, self.request_trace)
         if self.watchdog not in ("off", "warn", "raise"):
             raise DeepSpeedConfigError(
                 f"telemetry.watchdog must be off|warn|raise, got {self.watchdog!r}")
@@ -605,6 +661,10 @@ class ServingConfig:
     chunked_prefill: ChunkedPrefillConfig = field(default_factory=ChunkedPrefillConfig)
     fault_injection: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
     router: RouterConfig = field(default_factory=RouterConfig)
+    # observability sub-blocks (same schema as telemetry.ledger /
+    # telemetry.request_trace — the serving engine owns its own Telemetry)
+    ledger: LedgerConfig = field(default_factory=LedgerConfig)
+    request_trace: RequestTraceConfig = field(default_factory=RequestTraceConfig)
 
     def __post_init__(self):
         if isinstance(self.prefix_cache, dict):
@@ -615,6 +675,10 @@ class ServingConfig:
             self.fault_injection = _build(FaultInjectionConfig, self.fault_injection)
         if isinstance(self.router, dict):
             self.router = _build(RouterConfig, self.router)
+        if isinstance(self.ledger, dict):
+            self.ledger = _build(LedgerConfig, self.ledger)
+        if isinstance(self.request_trace, dict):
+            self.request_trace = _build(RequestTraceConfig, self.request_trace)
         if self.watchdog_mode not in ("off", "warn", "raise"):
             raise DeepSpeedConfigError(
                 f"serving.watchdog_mode must be off|warn|raise, "

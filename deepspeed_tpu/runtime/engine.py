@@ -136,10 +136,6 @@ class DeepSpeedEngine:
         self.train_batch_size = self.config.train_batch_size
         self.global_steps = 0
         self.global_samples = 0
-        self.timers = SynchronizedWallClockTimer()
-        self.tput_timer = ThroughputTimer(
-            batch_size=self.train_batch_size, steps_per_output=self.config.steps_per_print
-        )
         from ..monitor.monitor import MonitorMaster
 
         self.monitor = MonitorMaster(self.config)
@@ -160,6 +156,19 @@ class DeepSpeedEngine:
             jsonl_path=tcfg.jsonl_path if tcfg.enabled else "",
             watchdog_mode=tcfg.watchdog,
             device_sync_spans=tcfg.device_sync_spans,
+            ledger=tcfg.ledger.enabled,
+        )
+        # program-ledger join rules: the train step's cost model reads its
+        # measured wall time from the step-time histogram and publishes the
+        # engine's headline train/mfu gauge (docs/PERF.md)
+        self.telemetry.ledger.bind(
+            "train/train_step", wall_hist="train/step_time_sec", gauge="train")
+        # wall-clock timers mirror into the same registry (utils/timer.py —
+        # the standalone pre-spine path is deprecated)
+        self.timers = SynchronizedWallClockTimer(registry=self.telemetry.registry)
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size, steps_per_output=self.config.steps_per_print,
+            registry=self.telemetry.registry,
         )
         self._telemetry_bridge = (
             MonitorBridge(self.monitor)
@@ -1746,11 +1755,26 @@ class DeepSpeedEngine:
     def telemetry_snapshot(self) -> dict:
         """ONE call that reports everything: registry metrics (step-time
         histogram, throughput counters, boundary gauges, memory watermarks),
-        the compile table, and the trace-time collective summary. Appended
-        to the JSONL log (type ``snapshot``) when a sink is configured."""
+        the compile table, the program ledger (per-program flops/bytes/HBM
+        + derived MFU and roofline verdict), the HBM memory ledger (state
+        attributed to named pools), and the trace-time collective summary.
+        Appended to the JSONL log (type ``snapshot``) when a sink is
+        configured."""
         from ..comm.logger import comms_logger
+        from ..telemetry import hbm_snapshot, tree_bytes
 
-        snap = self.telemetry.snapshot(comm=comms_logger.summary())
+        state = getattr(self, "state", None)
+        pools = {
+            label: tree_bytes(state[key])
+            for key, label in (("params", "params"), ("opt", "opt_state"),
+                               ("master", "master_params"))
+            if isinstance(state, dict) and key in state
+        }
+        snap = self.telemetry.snapshot(
+            comm=comms_logger.summary(),
+            hbm=hbm_snapshot(
+                pools, self.config.telemetry.ledger.hbm_warn_fraction),
+        )
         self.telemetry.emit({"type": "snapshot", **snap})
         return snap
 

@@ -12,15 +12,23 @@ CommsLogger / nvtx / flops-profiler islands, unified):
   * ``RecompileWatchdog`` — wraps jitted entry points; every compilation is
     an event; paths declared compile-stable (serving decode) warn/raise on a
     second compilation.
+  * ``ProgramLedger`` — XLA cost model (flops/bytes/HBM) per watched
+    program, joined with the wall-time histograms into MFU + roofline rows
+    (telemetry/program_ledger.py; docs/PERF.md).
+  * ``RequestTracer`` — bounded per-request lifecycle timeline with a
+    Perfetto export (telemetry/request_trace.py).
   * exporters — JSONL event log, Prometheus text, MonitorMaster bridge.
 
-``Telemetry`` bundles the four with one config surface; engines hold one
+``Telemetry`` bundles them with one config surface; engines hold one
 instance each. Metric names follow ``subsystem/name``
 (docs/observability.md is the catalog).
 """
 
 from .exporters import JsonlExporter, MonitorBridge, prometheus_text
+from .program_ledger import (ProgramLedger, aot_cost, hbm_snapshot,
+                             platform_peaks, tree_bytes)
 from .registry import Counter, Gauge, Histogram, MetricsRegistry, get_registry
+from .request_trace import RequestTracer, request_timeline, to_perfetto
 from .tracing import Span, SpanTracer
 from .watchdog import RecompileError, RecompileWatchdog, abstract_signature
 
@@ -28,27 +36,33 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "Span", "SpanTracer", "RecompileError", "RecompileWatchdog",
     "abstract_signature", "JsonlExporter", "MonitorBridge", "prometheus_text",
+    "ProgramLedger", "aot_cost", "hbm_snapshot", "platform_peaks",
+    "tree_bytes", "RequestTracer", "request_timeline", "to_perfetto",
     "Telemetry",
 ]
 
 
 class Telemetry:
-    """One registry + tracer + watchdog + optional JSONL sink.
+    """One registry + tracer + watchdog + program ledger + optional JSONL
+    sink.
 
     ``registry=None`` creates a private registry (engine-scoped metrics
     should not mix across engine instances); pass ``get_registry()`` to
-    share the process-global one instead.
+    share the process-global one instead. ``ledger=False`` disables the
+    cost-model capture (``telemetry.ledger.enabled`` in config).
     """
 
     def __init__(self, registry: MetricsRegistry | None = None,
                  jsonl_path: str = "", watchdog_mode: str = "warn",
-                 device_sync_spans: bool = False):
+                 device_sync_spans: bool = False, ledger: bool = True):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.sink = JsonlExporter(jsonl_path) if jsonl_path else None
         self.tracer = SpanTracer(self.registry, self.sink,
                                  device_sync=device_sync_spans)
+        self.ledger = ProgramLedger(self.registry, enabled=ledger)
         self.watchdog = RecompileWatchdog(self.registry, self.sink,
-                                          mode=watchdog_mode)
+                                          mode=watchdog_mode,
+                                          ledger=self.ledger)
 
     # convenience passthroughs — instrumented code holds one handle
     def counter(self, name: str) -> Counter:
@@ -71,12 +85,16 @@ class Telemetry:
             self.sink.emit(event)
 
     def snapshot(self, **extra) -> dict:
-        """Registry snapshot + recompile table (+ caller extras), the one
-        call that reports everything."""
-        out = {
-            "metrics": self.registry.snapshot(),
-            "recompile_table": self.watchdog.compile_table(),
-        }
+        """Registry snapshot + recompile table + program ledger (+ caller
+        extras), the one call that reports everything. The ledger table is
+        computed FIRST so the MFU/intensity gauges it publishes land in the
+        same metrics snapshot."""
+        out: dict = {}
+        if self.ledger.enabled and self.ledger.entries:
+            out["program_ledger"] = self.ledger.table(self.registry)
+            out["platform"] = dict(self.ledger.platform)
+        out["metrics"] = self.registry.snapshot()
+        out["recompile_table"] = self.watchdog.compile_table()
         out.update(extra)
         return out
 

@@ -164,6 +164,12 @@ class MetricsRegistry:
                 f"requested as {cls.__name__}")
         return m
 
+    def get(self, name: str):
+        """Existing metric by name, or None — NEVER creates (the
+        create-on-first-use accessors below would materialize an empty
+        metric just for being asked about)."""
+        return self._metrics.get(name)
+
     def counter(self, name: str) -> Counter:
         return self._get(name, Counter)
 

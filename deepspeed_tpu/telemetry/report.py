@@ -1,12 +1,21 @@
 """Run-summary CLI over a telemetry JSONL event log.
 
     python -m deepspeed_tpu.telemetry.report run.jsonl [--top 10]
+        [--json] [--request UID] [--perfetto out.json]
 
 Pretty-prints, for CI logs and bench triage:
 
   * top spans by total time (count / total / mean / max per span path),
   * the recompile table (per watched path: compiles, compile seconds, the
     signatures that triggered them) with stable-path violations flagged,
+  * the program roofline table (per compiled program: XLA flops, bytes
+    accessed, arithmetic intensity, measured wall time, achieved TFLOPS vs
+    the platform peak, MFU, compute-/hbm-bound verdict — CPU/unknown
+    platforms stay labeled "unrated", never rated against a TPU peak),
+  * the HBM memory ledger (device memory attributed to named pools —
+    params / opt state / slot KV cache / prefix pool — next to the
+    runtime's in-use/peak/limit watermarks, WARN-flagged past the
+    configured threshold),
   * request latency percentiles (TTFT / per-output-token) from ``request``
     events,
   * the serving prefix-cache table (hit rate, tokens reused, pool occupancy,
@@ -18,6 +27,17 @@ Pretty-prints, for CI logs and bench triage:
     counters) when the snapshot came from a ``Router``,
   * the last registry ``snapshot`` event, if the run emitted one.
 
+Query modes:
+
+  * ``--request UID`` — print one request's lifecycle timeline (arrived ->
+    admitted -> chunk k -> first_token -> terminal, plus quarantine/failover
+    edges), merged across the router and every replica when the snapshot
+    came from a fleet.
+  * ``--perfetto out.json`` — export every request timeline in the last
+    snapshot as Chrome-trace JSON (load in ui.perfetto.dev).
+  * ``--json`` — machine-readable output: ``{snapshot, roofline, hbm,
+    requests[, request_timeline]}`` for CI and bench tooling.
+
 Pure stdlib + host-side: safe to run anywhere the JSONL landed (no jax
 import, no device).
 """
@@ -28,6 +48,8 @@ import argparse
 import json
 import sys
 from collections import defaultdict
+
+from .request_trace import request_timeline, to_perfetto
 
 
 def load_events(path: str) -> list[dict]:
@@ -58,6 +80,61 @@ def _fmt_s(s: float) -> str:
     if s >= 1e-3:
         return f"{s * 1e3:.1f}ms"
     return f"{s * 1e6:.0f}us"
+
+
+def _fmt_qty(x, suffix: str = "") -> str:
+    if x is None:
+        return "-"
+    x = float(x)
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(x) < 1000:
+            return f"{x:.2f}{unit}{suffix}"
+        x /= 1000
+    return f"{x:.2f}E{suffix}"
+
+
+def last_snapshot(events: list[dict]):
+    snap = None
+    for ev in events:
+        if ev.get("type") == "snapshot":
+            snap = ev
+    return snap
+
+
+def ledger_rows(snap: dict | None) -> list[dict]:
+    """Program-ledger rows from a snapshot — the engine's own plus, for a
+    Router snapshot, every replica's (rows gain a ``replica`` key)."""
+    if not snap:
+        return []
+    rows = [dict(r) for r in snap.get("program_ledger") or []]
+    for rid, rep in (snap.get("replicas") or {}).items():
+        for r in rep.get("program_ledger") or []:
+            rows.append({"replica": rid, **r})
+    return rows
+
+
+def hbm_tables(snap: dict | None) -> list[dict]:
+    """HBM-ledger dicts from a snapshot (engine's own + per replica)."""
+    if not snap:
+        return []
+    out = []
+    if snap.get("hbm"):
+        out.append(dict(snap["hbm"]))
+    for rid, rep in (snap.get("replicas") or {}).items():
+        if rep.get("hbm"):
+            out.append({"replica": rid, **rep["hbm"]})
+    return out
+
+
+def _platform_of(snap: dict | None) -> dict:
+    if not snap:
+        return {}
+    if snap.get("platform"):
+        return snap["platform"]
+    for rep in (snap.get("replicas") or {}).values():
+        if rep.get("platform"):
+            return rep["platform"]
+    return {}
 
 
 def summarize(events: list[dict], top: int = 10) -> str:
@@ -109,6 +186,74 @@ def summarize(events: list[dict], top: int = 10) -> str:
             lines.append(f"  {name:<40} {agg['n']:>8} {_fmt_s(agg['total_s']):>10}  {sig}{flag}")
         lines.append("")
 
+    # -- last snapshot (feeds the roofline / hbm / router tables) -------
+    snap = last_snapshot(events)
+
+    # -- program roofline -----------------------------------------------
+    # the ledger's static cost model joined with measured wall times
+    # (telemetry/program_ledger.py; docs/PERF.md): where step time and
+    # headroom actually are, per compiled program
+    lrows = ledger_rows(snap)
+    if lrows:
+        plat = _platform_of(snap)
+        peak = plat.get("peak_tflops")
+        head = (f"{plat.get('label', '?')}, peak {peak:g} TFLOPS / "
+                f"{plat.get('peak_hbm_gbps'):g} GB/s" if peak
+                else f"{plat.get('label', plat.get('platform', '?'))} — "
+                     "MFU unrated")
+        lines.append(f"program roofline ({head}):")
+        lines.append(
+            f"  {'program':<34} {'flops':>9} {'bytes':>9} {'inten':>6} "
+            f"{'wall p50':>9} {'achieved':>9} {'mfu':>6}  verdict")
+        for r in lrows[:top]:
+            name = r.get("name", "?")
+            if r.get("replica") is not None:
+                name = f"[{r['replica']}] {name}"
+            ach = r.get("achieved_tflops")
+            mfu = r.get("mfu")
+            inten = r.get("arith_intensity")
+            row = (f"  {name:<34} {_fmt_qty(r.get('flops')):>9} "
+                   f"{_fmt_qty(r.get('bytes_accessed'), 'B'):>9} ")
+            row += f"{inten:>6.2f}" if inten is not None else f"{'-':>6}"
+            row += (f" {_fmt_s(r['wall_p50_s']):>9}" if r.get("wall_p50_s")
+                    else f" {'-':>9}")
+            row += f" {ach:>8.3f}T" if ach is not None else f" {'-':>9}"
+            row += f" {mfu:>6.1%}" if mfu is not None else f" {'-':>6}"
+            row += f"  {r.get('roofline', '?')}"
+            if r.get("error"):
+                row += "  [unresolved]"
+            lines.append(row)
+        if len(lrows) > top:
+            lines.append(f"  ... +{len(lrows) - top} more programs")
+        lines.append("")
+
+    # -- hbm memory ledger ------------------------------------------------
+    hrows = hbm_tables(snap)
+    if hrows:
+        lines.append("hbm memory ledger:")
+        for h in hrows:
+            prefix = (f"  [{h['replica']}] " if h.get("replica") is not None
+                      else "  ")
+            pools = h.get("pools", {})
+            body = " ".join(f"{k}={_fmt_qty(v, 'B')}"
+                            for k, v in sorted(pools.items()))
+            lines.append(prefix + (body or "(no pools)"))
+            dev = h.get("device")
+            if dev:
+                warn = ""
+                if h.get("warn"):
+                    warn = (f"  <-- WARN: in-use past "
+                            f"{h.get('warn_fraction', 0):.0%} of limit")
+                lines.append(
+                    f"{prefix}device: in-use {_fmt_qty(dev.get('bytes_in_use'), 'B')} "
+                    f"peak {_fmt_qty(dev.get('peak_bytes_in_use'), 'B')} "
+                    f"limit {_fmt_qty(dev.get('bytes_limit'), 'B')}{warn}")
+            else:
+                lines.append(
+                    f"{prefix}pool total {_fmt_qty(h.get('pool_total_bytes'), 'B')} "
+                    "(backend reports no memory stats)")
+        lines.append("")
+
     # -- requests -------------------------------------------------------
     ttfts = sorted(ev["ttft_s"] for ev in events
                    if ev.get("type") == "request" and "ttft_s" in ev)
@@ -124,12 +269,6 @@ def summarize(events: list[dict], top: int = 10) -> str:
                 f"  per-tok  p50={_fmt_s(_pct(tpots, .5))} p90={_fmt_s(_pct(tpots, .9))} "
                 f"p99={_fmt_s(_pct(tpots, .99))}")
         lines.append("")
-
-    # -- last snapshot --------------------------------------------------
-    snap = None
-    for ev in events:
-        if ev.get("type") == "snapshot":
-            snap = ev
 
     # -- prefix cache ---------------------------------------------------
     pc = snap.get("prefix_cache") if snap is not None else None
@@ -245,14 +384,80 @@ def summarize(events: list[dict], top: int = 10) -> str:
     return "\n".join(lines).rstrip() + "\n"
 
 
+def request_table(events: list[dict]) -> list[dict]:
+    """Per-request rows from ``request`` events — the machine-readable
+    twin of the latency-percentile section."""
+    return [{k: ev[k] for k in ("uid", "slot", "prompt_len", "n_tokens",
+                                "ttft_s", "tpot_s", "status", "arrival_s",
+                                "finish_s", "prefix_hit_tokens") if k in ev}
+            for ev in events if ev.get("type") == "request"]
+
+
+def format_timeline(timeline: list[dict]) -> str:
+    """Render one request's merged lifecycle timeline."""
+    if not timeline:
+        return "no trace events for that request\n"
+    uid = timeline[0].get("uid")
+    lines = [f"request {uid} timeline ({len(timeline)} events):",
+             f"  {'t':>10} {'replica':>8} {'event':<12} detail"]
+    for ev in timeline:
+        detail = " ".join(
+            f"{k}={v}" for k, v in ev.items()
+            if k not in ("uid", "event", "t", "replica_id"))
+        lines.append(
+            f"  {_fmt_s(ev.get('t', 0.0)):>10} "
+            f"{str(ev.get('replica_id', '-')):>8} {ev['event']:<12} {detail}")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.telemetry.report",
         description="Pretty-print a telemetry JSONL run summary.")
     ap.add_argument("jsonl", help="path to the telemetry JSONL event log")
     ap.add_argument("--top", type=int, default=10, help="span rows to show")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output: {snapshot, roofline, "
+                         "hbm, requests[, request_timeline]}")
+    ap.add_argument("--request", type=int, default=None, metavar="UID",
+                    help="print one request's merged lifecycle timeline")
+    ap.add_argument("--perfetto", metavar="PATH", default=None,
+                    help="write the last snapshot's request timelines as "
+                         "Chrome-trace JSON (ui.perfetto.dev)")
     args = ap.parse_args(argv)
-    print(summarize(load_events(args.jsonl), top=args.top), end="")
+    events = load_events(args.jsonl)
+    snap = last_snapshot(events)
+
+    if args.perfetto:
+        timeline = request_timeline(snap or {})
+        with open(args.perfetto, "w") as f:
+            json.dump(to_perfetto(timeline), f)
+        print(f"wrote {len(timeline)} trace events for "
+              f"{len({e['uid'] for e in timeline})} requests to "
+              f"{args.perfetto}", file=sys.stderr)
+
+    if args.json:
+        out = {
+            "snapshot": snap,
+            "roofline": ledger_rows(snap),
+            "hbm": hbm_tables(snap),
+            "requests": request_table(events),
+        }
+        if args.request is not None:
+            out["request_timeline"] = request_timeline(snap or {},
+                                                       uid=args.request)
+        json.dump(out, sys.stdout)
+        sys.stdout.write("\n")
+        return 0
+
+    if args.request is not None:
+        print(format_timeline(request_timeline(snap or {}, uid=args.request)),
+              end="")
+        return 0
+
+    if args.perfetto:
+        return 0
+    print(summarize(events, top=args.top), end="")
     return 0
 
 

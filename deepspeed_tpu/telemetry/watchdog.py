@@ -87,12 +87,16 @@ def abstract_key(args, kwargs=None) -> tuple:
 
 class RecompileWatchdog:
     def __init__(self, registry: Optional[MetricsRegistry] = None, sink=None,
-                 mode: str = "warn"):
+                 mode: str = "warn", ledger=None):
         if mode not in ("off", "warn", "raise"):
             raise ValueError(f"watchdog mode must be off|warn|raise, got {mode!r}")
         self.registry = registry if registry is not None else get_registry()
         self.sink = sink
         self.mode = mode
+        # optional ProgramLedger (telemetry/program_ledger.py): every
+        # detected compilation is offered to it for cost-model capture —
+        # spec extraction only on this path; the XLA analysis is lazy
+        self.ledger = ledger
         self.events: list[dict] = []  # chronological compile events
         self._watched: dict[str, dict] = {}  # name -> {stable, compiles}
 
@@ -214,6 +218,12 @@ class RecompileWatchdog:
                 ev = self._record(
                     name, abstract_signature(args, kwargs), dt,
                     key=abstract_key(args, kwargs))
+                if self.ledger is not None:
+                    # cost-model capture (telemetry/program_ledger.py):
+                    # stores shape/dtype/sharding specs only — donated
+                    # operands' avals are still readable here, and the
+                    # XLA cost/memory analysis is deferred to table()
+                    self.ledger.capture(name, fn, args, kwargs, dt)
                 if stable and ev["n_for_name"] > 1:
                     self._violation(name, ev)
             return out

@@ -74,4 +74,42 @@ def axis_size(axis):
     return lax.psum(1, axis)
 
 
-__all__ = ["shard_map", "axis_size", "memory_space", "device_put_host"]
+def compiled_cost_analysis(compiled) -> dict:
+    """XLA cost model of a ``lower().compile()`` artifact as ONE dict.
+
+    ``Compiled.cost_analysis()`` returns a list of per-device dicts on jax
+    0.4.x and a plain dict on newer releases; some backends raise or return
+    None. Every caller (the program ledger, the flops profiler) goes through
+    here so the list-vs-dict shim lives in exactly one place. {} when the
+    backend has no cost model."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return dict(ca) if ca else {}
+
+
+def compiled_memory_stats(compiled) -> dict:
+    """``Compiled.memory_analysis()`` normalized to a plain dict of the
+    byte-count fields (argument/output/temp/alias/generated code) — the
+    HBM footprint of one executable. {} when the backend can't say."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for f in ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes"):
+        v = getattr(ma, f, None)
+        if v is not None:
+            out[f.replace("_size_in_bytes", "_bytes")] = int(v)
+    return out
+
+
+__all__ = ["shard_map", "axis_size", "memory_space", "device_put_host",
+           "compiled_cost_analysis", "compiled_memory_stats"]

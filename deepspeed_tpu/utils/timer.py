@@ -4,16 +4,39 @@ TPU-native analogue of ``deepspeed/utils/timer.py``: the reference uses CUDA
 events for device-accurate timing (utils/timer.py:20 CudaEventTimer); on TPU we
 bracket timed regions with ``jax.block_until_ready`` on a sentinel array, which
 drains the dispatch queue the same way an event sync drains a stream.
+
+These timers predate the telemetry spine (``deepspeed_tpu/telemetry/``) and
+are now UNIFIED with it: construct with ``registry=`` (a telemetry
+``MetricsRegistry``) and every ``stop()`` interval mirrors into the
+``timer/<name>_sec`` histogram — one spine, one report CLI, no second
+wall-clock breakdown to reconcile. The standalone path (no registry) keeps
+working for scripts but is deprecated and warns ONCE per process; the
+engines always pass their registry.
 """
 
 import time
 
 from .logging import logger
 
+_standalone_warned = False
+
+
+def _warn_standalone(cls_name: str) -> None:
+    global _standalone_warned
+    if _standalone_warned:
+        return
+    _standalone_warned = True
+    logger.warning(
+        "%s built without registry= — the standalone timer path is "
+        "deprecated; pass a telemetry MetricsRegistry so timings mirror "
+        "into the timer/<name>_sec histograms (docs/observability.md)",
+        cls_name)
+
 
 class _Timer:
-    def __init__(self, name: str):
+    def __init__(self, name: str, registry=None):
         self.name = name
+        self.registry = registry
         self.elapsed_ = 0.0
         self.started_ = False
         self.start_time = 0.0
@@ -34,9 +57,14 @@ class _Timer:
             import jax
 
             jax.block_until_ready(barrier_array)
-        self.elapsed_ += time.perf_counter() - self.start_time
+        dt = time.perf_counter() - self.start_time
+        self.elapsed_ += dt
         self.count += 1
         self.started_ = False
+        if self.registry is not None:
+            # telemetry mirror: each start->stop interval is one histogram
+            # observation, so the report CLI's percentiles cover these too
+            self.registry.histogram(f"timer/{self.name}_sec").observe(dt)
 
     def reset(self):
         self.elapsed_ = 0.0
@@ -54,14 +82,19 @@ class _Timer:
 
 
 class SynchronizedWallClockTimer:
-    """Named-timer registry (reference: utils/timer.py:31)."""
+    """Named-timer registry (reference: utils/timer.py:31). Pass
+    ``registry=`` to mirror every timer into telemetry histograms; the
+    registry-less form is deprecated (one-shot warning)."""
 
-    def __init__(self):
+    def __init__(self, registry=None):
+        self.registry = registry
         self.timers: dict[str, _Timer] = {}
+        if registry is None:
+            _warn_standalone(type(self).__name__)
 
     def __call__(self, name: str) -> _Timer:
         if name not in self.timers:
-            self.timers[name] = _Timer(name)
+            self.timers[name] = _Timer(name, registry=self.registry)
         return self.timers[name]
 
     @staticmethod
@@ -89,13 +122,17 @@ class SynchronizedWallClockTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec + TFLOPS estimate (reference: utils/timer.py:135)."""
+    """Samples/sec + TFLOPS estimate (reference: utils/timer.py:135).
+    With ``registry=`` the rolling samples/sec lands in the
+    ``train/samples_per_sec`` gauge at each report boundary."""
 
-    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50, monitor_memory: bool = False):
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
+                 monitor_memory: bool = False, registry=None):
         self.batch_size = max(batch_size, 1)
         self.start_step = start_step
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
+        self.registry = registry
         self.epoch_count = 0
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
@@ -123,6 +160,9 @@ class ThroughputTimer:
                     f"samples/sec={self.avg_samples_per_sec():.2f}, "
                     f"curr samples/sec={self.batch_size * self.steps_per_output / max(self.step_elapsed_time, 1e-9):.2f}"
                 )
+                if self.registry is not None:
+                    self.registry.gauge("train/samples_per_sec").set(
+                        self.avg_samples_per_sec())
                 self.step_elapsed_time = 0.0
 
     def avg_samples_per_sec(self) -> float:

@@ -23,12 +23,20 @@ def bench():
 
 
 def test_stamp_row_platform_and_comparable(bench):
+    # every row also carries the perf-xray keys: mfu null / roofline
+    # "unrated:<platform>" unless the child computed real ones
     assert bench._stamp_row({"platform": "tpu"}, "full") == {
-        "platform": "tpu", "bench_stage": "full", "comparable": True}
+        "platform": "tpu", "bench_stage": "full", "comparable": True,
+        "mfu": None, "roofline": "unrated:tpu"}
     assert bench._stamp_row({"platform": "cpu"}, "cpu_fallback")["comparable"] is False
     # a row that never ran anywhere stamps platform "none", non-comparable
     row = bench._stamp_row({}, "none")
     assert row["platform"] == "none" and row["comparable"] is False
+    assert row["mfu"] is None and row["roofline"] == "unrated:none"
+    # child-computed values are never overwritten by the stamp
+    rated = bench._stamp_row({"platform": "tpu", "mfu": 0.41,
+                              "roofline": "compute-bound"}, "full")
+    assert rated["mfu"] == 0.41 and rated["roofline"] == "compute-bound"
 
 
 def test_preflight_retries_with_bounded_backoff(bench):
