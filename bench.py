@@ -465,6 +465,219 @@ def _chaos(steps: int, seed: int) -> int:
     return 0
 
 
+def _chaos_serving(seed: int) -> int:
+    """Cross-process serving chaos drill (``bench.py --chaos-serving``):
+    3 REAL worker processes behind the Router's RPC transport; one is
+    SIGKILL'd mid-prefill and one mid-decode. Asserts the fleet contract
+    across genuine OS process boundaries: every accepted request reaches a
+    terminal state, every completed greedy stream is BIT-IDENTICAL to an
+    unfaulted single-engine run in this process (workers rebuild identical
+    params from the spec), the supervisor respawns both corpses within its
+    backoff budget and the replacements serve traffic, and the merged
+    telemetry snapshot attributes the dead workers' piggybacked timelines
+    to the right replica ids. Workers run with the RecompileWatchdog in
+    RAISE mode throughout — a new XLA program shape on any worker fails
+    the drill. In-process transport-fault variants live in tests/test_rpc.py;
+    this drill is the real-process proof. CPU-pinned correctness soak."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # parent and workers share one compile cache; repeat drills are warm
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests", ".xla_cache"))
+    import signal
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.inference import InferenceEngine, Router
+    from deepspeed_tpu.inference.serving import Request, ServingEngine
+    from deepspeed_tpu.launcher.serving_worker import WorkerSupervisor
+    from deepspeed_tpu.models.transformer import Model, TransformerConfig
+    from deepspeed_tpu.telemetry import request_timeline
+
+    t0 = time.perf_counter()
+    serving_cfg = {
+        "n_slots": 2, "max_seq_len": 128, "watchdog_mode": "raise",
+        # chunked prefill makes admission span several router steps, so
+        # the mid-PREFILL kill window is real, not a race
+        "chunked_prefill": {"enabled": True, "chunk_size": 16},
+    }
+    model_spec = {"vocab_size": 97, "max_seq_len": 128, "num_layers": 2,
+                  "num_heads": 4, "hidden_size": 32, "dtype": "float32",
+                  "loss_chunk_size": 0, "decode_attn": "xla",
+                  "pos_emb": "rotary"}
+    spec = {"model": model_spec, "engine_dtype": "fp32",
+            "serving": serving_cfg}
+
+    # -- unfaulted single-engine reference (identical PRNGKey(0) params) --
+    cfg = TransformerConfig(**{**model_spec, "dtype": jnp.float32})
+    ref_srv = ServingEngine(
+        InferenceEngine(model=Model(cfg), config={"dtype": "fp32"}),
+        config=serving_cfg)
+    rng = np.random.default_rng(seed)
+    prompts = {i: rng.integers(0, 97, size=int(rng.integers(5, 24))).astype(np.int32)
+               for i in range(6)}
+    prompts[6] = rng.integers(0, 97, size=90).astype(np.int32)  # mid-prefill bait
+    for j in range(7, 12):  # spares: kill-2 bait + respawn traffic
+        prompts[j] = rng.integers(0, 97, size=int(rng.integers(5, 24))).astype(np.int32)
+
+    def mk(uid):
+        return Request(uid=uid, prompt=prompts[uid], max_new_tokens=24)
+
+    for uid in sorted(prompts):
+        ref_srv.submit(mk(uid))
+    ref = {u: r.tokens for u, r in ref_srv.drain().items()}
+    assert all(r.status == "ok" for r in ref_srv.drain().values())
+
+    sup = WorkerSupervisor(
+        spec, 3,
+        transport={"call_timeout_s": 120.0, "boot_timeout_s": 300.0,
+                   "heartbeat_timeout_s": 30.0, "base_delay_s": 0.05,
+                   "max_delay_s": 0.2, "jitter": 0.0},
+        respawn_backoff={"max_attempts": 10, "base_delay_s": 0.2,
+                         "max_delay_s": 1.0, "jitter": 0.25},
+        seed=seed)
+    submitted: set = set()
+    try:
+        clients = sup.start()
+        router = Router(config={"router": {"replicas": 3,
+                                           "health": {"timeout": 60.0}}},
+                        replica_engines=clients)
+        rid_to_slot = {0: 0, 1: 1, 2: 2}
+
+        def drive_until_terminal(uids):
+            for _ in range(400):
+                router.step(now=0.0)
+                if all(u in router.results for u in uids):
+                    return
+            raise AssertionError(
+                f"uids {sorted(set(uids) - set(router.results))} never "
+                "reached a terminal state")
+
+        # -- phase 1: kill a worker MID-PREFILL ---------------------------
+        for uid in range(6):
+            router.submit(mk(uid))
+            submitted.add(uid)
+        router.step(now=0.0)
+        router.step(now=0.0)  # shorts admitted, decoding
+        router.submit(mk(6))
+        submitted.add(6)
+        victim_prefill = router.owner_of(6)
+        router.step(now=0.0)  # long prompt enters chunked prefill
+        sup.kill(rid_to_slot[victim_prefill], signal.SIGKILL)
+        drive_until_terminal(list(submitted))
+        assert router.replica_states()[victim_prefill] == "dead"
+
+        # -- phase 2: kill another worker MID-DECODE ----------------------
+        for uid in (7, 8):
+            router.submit(mk(uid))
+            submitted.add(uid)
+        router.step(now=0.0)
+        router.step(now=0.0)  # decoding
+        victim_decode = router.owner_of(7)
+        if victim_decode is None or victim_decode == victim_prefill:
+            victim_decode = router.owner_of(8)
+        assert victim_decode is not None and victim_decode != victim_prefill
+        sup.kill(rid_to_slot[victim_decode], signal.SIGKILL)
+        drive_until_terminal(list(submitted))
+
+        # -- the fleet contract, asserted ---------------------------------
+        missing = sorted(submitted - set(router.results))
+        assert not missing, f"no terminal state for {missing}"
+        bad_status = {u: router.results[u].status for u in submitted
+                      if not router.results[u].ok}
+        assert not bad_status, f"non-ok terminals: {bad_status}"
+        for u in submitted:
+            np.testing.assert_array_equal(
+                router.results[u].tokens, ref[u],
+                err_msg=f"uid {u} diverged from the unfaulted run")
+        stats = router.router_stats()
+        assert stats["failovers_recovered"] >= 2, stats
+
+        # -- supervisor respawn within the backoff budget -----------------
+        t_respawn = time.monotonic()
+        dead_slots = sup.poll()
+        assert sorted(dead_slots) == sorted(
+            rid_to_slot[r] for r in (victim_prefill, victim_decode))
+        for slot in dead_slots:
+            new_client = sup.respawn(slot)
+            rid = router.attach_replica(new_client)
+            rid_to_slot[rid] = slot
+        respawn_s = time.monotonic() - t_respawn
+        assert sup.respawns == 2
+        # budget: 2 x (backoff <= 1.25s + boot); boots measured ~3-5s cold
+        assert respawn_s < 2 * (1.25 + 300.0), respawn_s
+
+        # respawned replicas serve fresh traffic (3 idle healthy replicas,
+        # 3 requests -> least-loaded puts one on each, incl. both rookies)
+        for uid in (9, 10, 11):
+            router.submit(mk(uid))
+            submitted.add(uid)
+        rookie_rids = [r for r in router.replica_states()
+                       if r > 2]  # attached after the kills
+        assert any(router.owner_of(u) in rookie_rids for u in (9, 10, 11))
+        drive_until_terminal([9, 10, 11])
+        for u in (9, 10, 11):
+            assert router.results[u].ok
+            np.testing.assert_array_equal(router.results[u].tokens, ref[u])
+
+        # -- merged snapshot attribution + watchdog-raise inventory -------
+        snap = router.telemetry_snapshot()
+        for victim in (victim_prefill, victim_decode):
+            dead_snap = snap["replicas"][victim]
+            assert "unreachable" in dead_snap
+            mirror = dead_snap["request_trace"]
+            assert mirror and all(e["replica_id"] == victim for e in mirror)
+        tl = request_timeline(snap, 6)
+        fo = [e for e in tl if e["event"] == "failover"]
+        assert fo and fo[0]["from_replica"] == victim_prefill
+        # the dead worker never stored its mid-prefill KV anywhere a
+        # replay could see — its pool died with the process; bit-equality
+        # above is the proof. Reachable replicas: ONE decode program each.
+        decode_compiles = {}
+        for r, state in router.replica_states().items():
+            if state == "dead":
+                continue
+            decode_compiles[r] = router._replicas[r].engine.compile_counts()["decode"]
+        assert all(v == 1 for v in decode_compiles.values()), decode_compiles
+
+        rpc_totals = {}
+        for r in router._replicas:
+            stats_fn = getattr(r.engine, "rpc_stats", None)
+            if stats_fn is None:
+                continue
+            for k, v in stats_fn().items():
+                if isinstance(v, (int, float)) and not k.startswith("call_sec"):
+                    rpc_totals[k] = rpc_totals.get(k, 0) + v
+
+        from collections import Counter as _Counter
+
+        statuses = _Counter(router.results[u].status for u in submitted)
+        print(json.dumps({
+            "metric": "serving kill-9 chaos drill (failed-over requests recovered)",
+            "value": int(stats["failovers_recovered"]),
+            "unit": "requests",
+            # CPU-pinned correctness soak: never a trajectory datapoint
+            "platform": "cpu",
+            "comparable": False,
+            "mfu": None,
+            "roofline": "unrated:cpu",
+            "workers": 3,
+            "kills": {"mid_prefill_rid": victim_prefill,
+                      "mid_decode_rid": victim_decode},
+            "n_requests": len(submitted),
+            "statuses": dict(statuses),
+            "greedy_bitwise_match": True,
+            "respawns": sup.respawns,
+            "respawn_wait_s": round(respawn_s, 2),
+            "rpc": rpc_totals,
+            "seed": seed,
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+        }), flush=True)
+        return 0
+    finally:
+        sup.shutdown()
+
+
 def _stamp_row(obj, stage):
     """Backend provenance on EVERY bench row: ``platform`` plus a
     ``comparable`` verdict — False when the row ran on a fallback backend
@@ -660,6 +873,17 @@ if __name__ == "__main__":
                   file=sys.stderr)
             sys.exit(2)
         sys.exit(_fault_smoke(rate))
+    if "--chaos-serving" in sys.argv:
+        # usage-error exit 2 on malformed values (same contract as --chaos)
+        try:
+            chaos_seed = 0
+            if "--chaos-seed" in sys.argv:
+                chaos_seed = int(sys.argv[sys.argv.index("--chaos-seed") + 1])
+        except (IndexError, ValueError) as e:
+            print(f"usage: bench.py --chaos-serving [--chaos-seed <int>] ({e})",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_chaos_serving(chaos_seed))
     if "--chaos" in sys.argv:
         # usage-error exit 2 on malformed values (same contract as
         # --fault-rate): --chaos [steps >= 6] [--chaos-seed <int>]

@@ -53,6 +53,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
 
 from ..launcher.launch import terminate_process_tree
+from ..resilience.heartbeat import HeartbeatJudge
 from ..resilience.retry import RetryPolicy, backoff_delay
 from ..utils.logging import logger
 from .elasticity import ElasticityIncompatibleWorldSize, compute_elastic_config
@@ -110,8 +111,12 @@ class DSElasticAgent:
         self.heartbeat_grace = (
             float(heartbeat_grace) if heartbeat_grace is not None
             else 10.0 * self.heartbeat_timeout)
-        self._hb_launch = 0.0
-        self._hb_created_mtime = 0.0
+        # shared monotonic staleness judge (resilience/heartbeat.py): the
+        # verdict clock is monotonic time between this agent's observations
+        # of the mtime CHANGING, never wall-clock-vs-mtime arithmetic — an
+        # NTP step used to be able to mint a false hung-worker verdict (or
+        # hide a real one). Re-armed per generation in _launch.
+        self._hb_judge: Optional[HeartbeatJudge] = None
         if isinstance(restart_backoff, dict):
             restart_backoff = RetryPolicy(**restart_backoff)
         # default: 1s doubling to 30s, +/-25% deterministic jitter — tight
@@ -164,10 +169,10 @@ class DSElasticAgent:
             # launch, not at the previous generation's last touch
             with open(self.heartbeat_file, "w"):
                 pass
-            self._hb_launch = time.time()
-            # the creation mtime distinguishes "never touched yet" (startup
-            # grace applies) from "touched then went quiet" (step timeout)
-            self._hb_created_mtime = os.path.getmtime(self.heartbeat_file)
+            self._hb_judge = HeartbeatJudge(
+                self.heartbeat_file, self.heartbeat_timeout,
+                self.heartbeat_grace)
+            self._hb_judge.reset()
         logger.info(
             "elastic agent: launching generation %d at world=%d "
             "(batch=%d, micro=%d): %s",
@@ -179,18 +184,19 @@ class DSElasticAgent:
         touched the file within ``heartbeat_timeout`` seconds. A worker
         that has never touched the file is still starting up (loading,
         compiling) and gets ``heartbeat_grace`` instead — only after its
-        first touch does the step-cadence timeout apply."""
-        if not self.heartbeat_file or self.heartbeat_timeout <= 0:
+        first touch does the step-cadence timeout apply.
+
+        The verdict clock (``resilience/heartbeat.HeartbeatJudge``, shared
+        with the serving WorkerSupervisor) is ``time.monotonic()`` between
+        this agent's own observations of the mtime CHANGING — never
+        ``time.time() - mtime``: mtime is a wall-clock stamp, so an NTP
+        step (or a worker on a skewed filesystem clock) could otherwise
+        mint a false hung verdict and SIGKILL a healthy worker, or stretch
+        a real hang's detection."""
+        if (not self.heartbeat_file or self.heartbeat_timeout <= 0
+                or self._hb_judge is None):
             return False
-        try:
-            mtime = os.path.getmtime(self.heartbeat_file)
-        except OSError:  # worker (or operator) deleted it: treat as stale
-            return True
-        if time.time() - mtime <= self.heartbeat_timeout:
-            return False
-        if mtime == self._hb_created_mtime:
-            return time.time() - self._hb_launch > self.heartbeat_grace
-        return True
+        return self._hb_judge.stale()
 
     def _backoff(self) -> None:
         """Sleep the bounded-exponential delay for the upcoming restart

@@ -2,4 +2,5 @@
 
 from .engine import InferenceEngine
 from .router import Router
+from .rpc import ReplicaClient, RpcClient, RpcServer
 from .serving import Request, RequestResult, ServingEngine, SlotWorker

@@ -49,22 +49,34 @@ returns every uid that reached a terminal state since the last call, across
 all replicas — a direct driver never hangs on a request whose replica died
 mid-flight.
 
-In-process model: every replica shares the caller's ``InferenceEngine``
-(params/mesh), which is exactly the multi-replica-per-host deployment; a
-multi-host fleet would put each ServingEngine in its own process and drive
-the same Router state machine over RPC — the host-side contract
-(owner map, exactly-once failover, drain states) is deployment-agnostic.
+Deployment models — the SAME Router state machine drives both:
+
+  * in-process (default): every replica shares the caller's
+    ``InferenceEngine`` (params/mesh) — the multi-replica-per-host
+    deployment, built here from ``config``.
+  * cross-process: pass ``replica_engines=[...]`` — any mix of in-process
+    ``ServingEngine``s and ``inference/rpc.ReplicaClient``s fronting worker
+    processes (``launcher/serving_worker.py``). The Router keeps its OWN
+    copy of every accepted request (the owner map carries the payload, not
+    just the id), so failover after a SIGKILL'd worker — whose queues,
+    slots and prefix pool are simply gone — replays from router-side state.
+    Transport verdicts map onto the health machine: an ``RpcTimeout`` step
+    is a HUNG verdict (the call may have executed; outcome unknown), any
+    other transport failure is DEAD. The "dead mid-prefill never
+    prefix_store'd" rule is enforced by the process boundary itself: the
+    dead worker's pool died with it.
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
 
-from ..resilience import FaultInjector, RequestRejected
+from ..resilience import FaultInjector, RequestRejected, RpcError, RpcTimeout
 from ..resilience.retry import backoff_delay
 from ..runtime.config import (FaultInjectionConfig, RequestTraceConfig,
                               RouterConfig, RouterHealthConfig)
@@ -118,13 +130,23 @@ class Router:
     bundle for ``router/*`` metrics and the one JSONL sink.
     """
 
-    def __init__(self, engine: InferenceEngine, config: dict | None = None,
+    def __init__(self, engine: InferenceEngine | None = None,
+                 config: dict | None = None,
                  *, replicas: int | None = None,
-                 telemetry: Telemetry | None = None):
+                 telemetry: Telemetry | None = None,
+                 replica_engines: list | None = None):
         config = dict(config or {})
         rc = config.get("router", {})
         if isinstance(rc, dict):
             rc = RouterConfig(**rc)
+        if replica_engines is not None:
+            if not replica_engines:
+                raise ValueError("replica_engines must not be empty")
+            rc.replicas = len(replica_engines)
+        elif engine is None:
+            raise ValueError(
+                "Router needs an InferenceEngine to build in-process "
+                "replicas, or prebuilt replica_engines")
         if replicas is not None:
             rc.replicas = int(replicas)
             if rc.replicas < 1:
@@ -165,16 +187,35 @@ class Router:
         # would interleave half-written lines
         sub.pop("jsonl_path", None)
         self._replicas: list[_Replica] = []
-        for rid in range(rc.replicas):
-            e = ServingEngine(engine, config=sub, replica_id=rid)
-            # one clock across the fleet: replica-relative timings
-            # (queue wait, TTFT) stay comparable and step(now=...) means
-            # the same instant on every replica
-            e.set_epoch(self._epoch)
-            self._replicas.append(_Replica(rid, e))
+        if replica_engines is not None:
+            for rid, e in enumerate(replica_engines):
+                # a ReplicaClient mirrors its rpc/* transport metrics into
+                # the fleet registry; in-process engines have no transport
+                if hasattr(e, "bind_telemetry"):
+                    e.bind_telemetry(self.telemetry)
+                # one clock across the fleet (a remote replica re-anchors
+                # its own perf_counter to the router's elapsed time)
+                e.set_epoch(self._epoch)
+                self._replicas.append(_Replica(rid, e))
+        else:
+            for rid in range(rc.replicas):
+                e = ServingEngine(engine, config=sub, replica_id=rid)
+                # one clock across the fleet: replica-relative timings
+                # (queue wait, TTFT) stay comparable and step(now=...) means
+                # the same instant on every replica
+                e.set_epoch(self._epoch)
+                self._replicas.append(_Replica(rid, e))
         self._owner: dict[int, int] = {}      # live uid -> replica id
         self._seen: dict[int, set] = {}       # uid -> replicas that held it
         self._failovers: dict[int, int] = {}  # uid -> failover count
+        # the owner map's PAYLOAD: the router's own copy of every accepted,
+        # non-terminal request. Failover must not depend on asking the
+        # failed replica for its requests back — a SIGKILL'd worker process
+        # cannot answer, and an in-process replica shouldn't need to.
+        self._requests: dict[int, Request] = {}
+        # per-replica mirror of piggybacked request-trace events: the
+        # merged timeline's source for a replica whose process is gone
+        self._trace_mirror: dict[int, deque] = {}
         self._results: dict[int, RequestResult] = {}
         # uids made terminal OUTSIDE a step (cancel()) — drained into the
         # next step()'s return so the terminal-uid contract stays complete
@@ -240,10 +281,35 @@ class Router:
             raise ValueError(
                 f"request uid {request.uid} is already in flight or "
                 "finished; uids must be unique per router")
-        target = self._pick(healthy, request)
-        uid = target.engine.submit(request)
+        while True:
+            target = self._pick(healthy, request)
+            try:
+                uid = target.engine.submit(request)
+                break
+            except RpcError as e:
+                # a dispatch that cannot reach its replica earns its
+                # verdict early, on the SAME mapping as step(): a timeout
+                # is HUNG (slow-but-alive earns probation, not permanent
+                # death), anything else is DEAD. Either way the replica
+                # stops accepting, its in-flight work fails over, and we
+                # re-pick among the survivors. If the submit executed
+                # remotely but its reply was lost, the worker holds an
+                # orphaned copy the owner map never points to — its
+                # completion is ignored by _record (docs/serving.md)
+                log_dist(f"router: replica {target.rid} transport failed at "
+                         f"dispatch ({type(e).__name__}: {e})", ranks=[0])
+                self._fail(target,
+                           "hung" if isinstance(e, RpcTimeout) else "dead",
+                           now, self._pending_terminal)
+                healthy = self._accepting()
+                if not healthy:
+                    tm.counter("router/shed").inc()
+                    raise RequestRejected(
+                        request.uid, "no_healthy_replicas",
+                        "last accepting replica failed at dispatch") from e
         self._owner[uid] = target.rid
         self._seen.setdefault(uid, set()).add(target.rid)
+        self._requests[uid] = request
         target.dispatched += 1
         tm.counter("router/dispatched").inc()
         if self.tracer is not None:
@@ -276,6 +342,7 @@ class Router:
         r.completed += 1
         del self._owner[uid]
         self._seen.pop(uid, None)
+        self._requests.pop(uid, None)
 
     def _collect(self, r: _Replica, uids, terminal: list) -> None:
         for uid in uids:
@@ -290,6 +357,7 @@ class Router:
             prompt_len=int(np.asarray(req.prompt).shape[-1]),
             arrival_time=req.arrival_time, finish_time=now, status=status)
         self._results[req.uid] = res
+        self._requests.pop(req.uid, None)
         self.telemetry.emit({
             "type": "request", "uid": req.uid, "slot": -1,
             "prompt_len": res.prompt_len, "n_tokens": 0, "status": status,
@@ -322,7 +390,21 @@ class Router:
             return
         self._failovers[req.uid] = n + 1
         tgt = self._pick(targets, req)
-        tgt.engine.requeue(req)
+        try:
+            tgt.engine.requeue(req)
+        except RpcError:
+            # the chosen survivor's transport died between verdicts — its
+            # own dead verdict lands on its next step; this request's
+            # exactly-once budget is spent on the failed replay
+            self._owner.pop(req.uid, None)
+            self._seen.pop(req.uid, None)
+            self._synth_result(req, "failed_replica")
+            terminal.append(req.uid)
+            tm.counter("router/failed_requests").inc()
+            if self.tracer is not None:
+                self.tracer.record(req.uid, "failover", from_replica=from_rid,
+                                   outcome="failed_replica")
+            return
         self._owner[req.uid] = tgt.rid
         seen.add(tgt.rid)
         tgt.dispatched += 1
@@ -337,10 +419,12 @@ class Router:
     def _fail(self, r: _Replica, verdict: str, now: float,
               terminal: list) -> None:
         """Apply a hung/dead verdict: move the replica through its state
-        machine and fail over every request it still owned."""
+        machine and fail over every request it still owned. The failover
+        population comes from the ROUTER's own request map — never from
+        asking the failed replica (a SIGKILL'd worker cannot answer)."""
         tm = self.telemetry
-        live = [req for req in r.engine.live_requests()
-                if self._owner.get(req.uid) == r.rid]
+        live = [self._requests[uid] for uid, rid in list(self._owner.items())
+                if rid == r.rid and uid in self._requests]
         if verdict == "hung":
             r.hung_verdicts += 1
             tm.counter("router/hung_verdicts").inc()
@@ -355,6 +439,15 @@ class Router:
         if verdict == "dead":
             r.state = "dead"
             tm.counter("router/replicas_dead").inc()
+            closer = getattr(r.engine, "close", None)
+            if closer is not None:
+                # a remote replica's client is closed so later snapshots /
+                # cancels fail FAST instead of paying reconnect backoff
+                # toward a process that is gone
+                try:
+                    closer()
+                except Exception:  # noqa: BLE001 — teardown is best-effort
+                    pass
             log_dist(f"router: replica {r.rid} marked DEAD "
                      f"({len(live)} in-flight requests failing over)",
                      ranks=[0])
@@ -372,9 +465,14 @@ class Router:
                 f"{delay:.2f}s, {len(live)} requests failing over", ranks=[0])
             # abandon its work host-side so a re-admitted replica doesn't
             # keep decoding requests that now live elsewhere (its cancelled
-            # results are ignored: the owner map has moved on)
+            # results are ignored: the owner map has moved on). Best-effort
+            # by construction: a genuinely hung worker process cannot
+            # acknowledge the cancel either
             for req in live:
-                r.engine.cancel(req.uid)
+                try:
+                    r.engine.cancel(req.uid)
+                except Exception:  # noqa: BLE001 — hung transport
+                    pass
         r.failed_over += len(live)
         for req in live:
             self._failover(req, terminal, from_rid=r.rid)
@@ -385,6 +483,21 @@ class Router:
         tm.gauge("router/healthy_replicas").set(
             sum(1 for r in self._replicas if r.state == "healthy"))
         tm.gauge("router/live_requests").set(len(self._owner))
+
+    def _mirror_trace(self, r: _Replica) -> None:
+        """Mirror the replica's piggybacked request-trace flush into a
+        router-side ring: the merged timeline's only source once the
+        replica's process is gone (its own buffer died with it)."""
+        take = getattr(r.engine, "take_trace_flush", None)
+        if take is None:
+            return
+        try:
+            flush = take()
+        except Exception:  # noqa: BLE001 — tracing never fails a step
+            return
+        if flush:
+            self._trace_mirror.setdefault(
+                r.rid, deque(maxlen=2048)).extend(flush)
 
     # -- stepping --------------------------------------------------------
 
@@ -419,11 +532,21 @@ class Router:
             try:
                 uids = r.engine.step(now=now,
                                      enforce_deadlines=enforce_deadlines)
+            except RpcTimeout as e:
+                # the transport deadline elapsed with the call's outcome
+                # unknown — the cross-process spelling of a step observed
+                # past health.timeout: a HUNG verdict (probation, maybe the
+                # process recovers), never a dead one
+                log_dist(f"router: replica {r.rid} step timed out over RPC "
+                         f"({e})", ranks=[0])
+                self._fail(r, "hung", now, terminal)
+                continue
             except Exception as e:  # noqa: BLE001 — a dead worker IS an exception
                 log_dist(f"router: replica {r.rid} step raised "
                          f"{type(e).__name__}: {e}", ranks=[0])
                 self._fail(r, "dead", now, terminal)
                 continue
+            self._mirror_trace(r)
             latency = time.perf_counter() - t0
             compiled = r.engine.last_step_compiled
             if self._inj is not None and self._inj.replica_hang(
@@ -496,7 +619,25 @@ class Router:
                 if w is None:
                     continue  # already admitted — finishes in place
                 tgt = self._pick(eligible, w)
-                tgt.engine.requeue(w)
+                try:
+                    tgt.engine.requeue(w)
+                except RpcError:
+                    # sibling transport died mid-migration: hand the
+                    # request back to the draining replica (usually alive —
+                    # we were just mid-conversation with it) to finish in
+                    # place; the sibling's dead verdict lands on its next
+                    # step
+                    try:
+                        r.engine.requeue(w)
+                    except RpcError:
+                        # both transports failed with the request held by
+                        # NO engine — spend its failover budget rather
+                        # than strand the uid (the payload is still in
+                        # self._requests; _failover re-queues it on a
+                        # clean replica or fails it terminally)
+                        self._failover(w, self._pending_terminal,
+                                       from_rid=r.rid)
+                    continue
                 self._owner[w.uid] = tgt.rid
                 self._seen.setdefault(w.uid, set()).add(tgt.rid)
                 tgt.dispatched += 1
@@ -555,11 +696,37 @@ class Router:
             self.step()
         return {u: self._results[u] for u in target}
 
+    # -- fleet membership ------------------------------------------------
+
+    def attach_replica(self, engine) -> int:
+        """Grow the fleet at runtime — the worker supervisor's respawn
+        path: a SIGKILL'd worker's replacement process joins as a NEW
+        replica id (the dead rid stays detached; a fresh process must not
+        inherit its predecessor's exactly-once history or drain state).
+        ``engine`` is anything with the scheduler surface — an in-process
+        ``ServingEngine`` or an ``rpc.ReplicaClient``."""
+        rid = len(self._replicas)
+        if hasattr(engine, "bind_telemetry"):
+            engine.bind_telemetry(self.telemetry)
+        engine.set_epoch(self._epoch)
+        self._replicas.append(_Replica(rid, engine))
+        self.telemetry.gauge("router/replicas").set(len(self._replicas))
+        self.telemetry.counter("router/replicas_attached").inc()
+        self._update_gauges()
+        log_dist(f"router: attached replica {rid} "
+                 f"({len(self._accepting())} accepting dispatch)", ranks=[0])
+        return rid
+
     # -- observability ---------------------------------------------------
 
     @property
     def results(self) -> dict[int, RequestResult]:
         return dict(self._results)
+
+    def owner_of(self, uid: int) -> Optional[int]:
+        """Replica id currently holding live request ``uid`` (None once
+        terminal/unknown) — chaos drills target their kills with this."""
+        return self._owner.get(uid)
 
     def replica_states(self) -> dict[int, str]:
         return {r.rid: r.state for r in self._replicas}
@@ -597,6 +764,23 @@ class Router:
         ``ServingEngine.telemetry_snapshot()``s, kept under their replica
         ids so counter names never collide across replicas. Appended to the
         router's JSONL sink (type ``snapshot``) when one is configured."""
+        reps: dict = {}
+        for r in self._replicas:
+            try:
+                reps[r.rid] = r.engine.telemetry_snapshot()
+            except Exception as e:  # noqa: BLE001 — a gone process can't report
+                # the replica cannot report (SIGKILL'd worker, closed
+                # transport): substitute the router-side trace mirror so
+                # the merged request_timeline() still shows every event the
+                # replica flushed before dying
+                reps[r.rid] = {
+                    "replica_id": r.rid,
+                    "unreachable": f"{type(e).__name__}: {e}",
+                    "request_trace": list(self._trace_mirror.get(r.rid, ())),
+                }
+                stats_fn = getattr(r.engine, "rpc_stats", None)
+                if stats_fn is not None:
+                    reps[r.rid]["transport"] = stats_fn()
         snap = {
             "router": {
                 "metrics": self.telemetry.registry.snapshot(),
@@ -604,8 +788,7 @@ class Router:
                 **({"request_trace": self.tracer.events()}
                    if self.tracer is not None else {}),
             },
-            "replicas": {r.rid: r.engine.telemetry_snapshot()
-                         for r in self._replicas},
+            "replicas": reps,
         }
         self.telemetry.emit({"type": "snapshot", **snap})
         return snap

@@ -970,6 +970,20 @@ class ServingEngine:
         Call only while idle — in-flight requests' timings are epoch-relative."""
         self._epoch = float(epoch)
 
+    def take_trace_flush(self, limit: int = 256) -> list[dict]:
+        """Incremental drain of request-trace events for a Router's mirror:
+        events recorded since the last call (bounded, non-destructive — the
+        engine's own ring keeps them too). A Router calls this on every
+        step so a replica PROCESS that dies between steps has already
+        shipped its timeline; the merged ``request_timeline()`` then still
+        shows the killed worker's admitted/first_token edges next to the
+        router's failover edge. Empty when tracing is off."""
+        if self.tracer is None:
+            return []
+        events, self._trace_cursor = self.tracer.events_since(
+            getattr(self, "_trace_cursor", 0), limit)
+        return events
+
     @property
     def last_step_compiled(self) -> bool:
         """True if the most recent ``step()`` paid at least one program

@@ -1,0 +1,490 @@
+"""Serving worker process: one ``ServingEngine`` behind the serving RPC.
+
+``python -m deepspeed_tpu.launcher.serving_worker --socket S --spec F``
+boots one scheduler+worker pair (model/params rebuilt deterministically
+from the spec — params come from ``PRNGKey(0)``, so every worker of a
+fleet, and the router's reference engine, hold bit-identical weights) and
+serves the scheduler surface over ``inference/rpc.RpcServer``. The Router
+drives it through ``rpc.ReplicaClient`` exactly as it drives an in-process
+replica.
+
+Process lifecycle:
+
+  * heartbeat — when ``--heartbeat FILE`` is given the worker touches it on
+    every serve-loop tick (throttled to ~5 Hz). The supervisor judges
+    staleness on a MONOTONIC clock against its own observations of the
+    file changing, so an NTP step can neither mint a false hung verdict
+    nor hide a real one.
+  * SIGTERM — drain-then-exit, reusing ``resilience/preemption.py``: the
+    handler only sets a flag; the serve loop notices it at a frame
+    boundary, stops serving, runs ``engine.drain()`` so every accepted
+    request still reaches a terminal state in-process, prints a final
+    ``{"event": "drained", ...}`` JSON line, and exits 0. (The Router-side
+    rolling-restart path drains the replica FIRST — migrating queued work
+    — so by the time SIGTERM lands the worker is typically idle.)
+  * SIGKILL — nothing runs; the Router sees ``RpcConnectionLost`` on its
+    next call (DEAD verdict, exactly-once failover from router-side
+    request state) and the ``WorkerSupervisor`` respawns a fresh process
+    after its bounded backoff. This is the ``bench.py --chaos-serving``
+    drill's fault.
+
+Replay-safe step contract: terminal uids (and their encoded results)
+accumulate UNACKED across step replies until the client acknowledges them
+on its next step — a reply lost to a connection reset is re-delivered, and
+the Router's ``_collect`` dedups. ``withdraw`` results are cached per uid
+for the same reason. Each step reply also piggybacks the engine's bounded
+request-trace flush, so a later SIGKILL cannot take the timeline with it.
+
+``WorkerSupervisor`` owns spawn/respawn: one process per replica slot,
+socket + heartbeat under a (short-pathed) work directory, heartbeat-
+timeout/SIGKILL discipline borrowed from ``elasticity/elastic_agent.py``,
+and bounded-backoff respawn pacing from ``resilience/retry.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from collections import Counter
+from typing import Optional
+
+import threading
+
+from ..inference.rpc import (ReplicaClient, RpcConnectionLost, RpcServer,
+                             _dec_value, decode_request, encode_request,
+                             encode_result)
+from ..resilience.heartbeat import HeartbeatJudge
+from ..resilience.preemption import PreemptionGuard
+from ..resilience.retry import RetryPolicy, backoff_delay
+from ..runtime.config import RouterTransportConfig
+from ..utils.logging import logger
+
+
+def build_serving_engine(spec: dict, replica_id: int | str = 0):
+    """Deterministic engine construction from a plain-JSON spec:
+    ``{"model": {TransformerConfig kwargs, "dtype": "float32"},
+    "engine_dtype": "fp32", "serving": {ServingEngine config}}``.
+    Params are initialized from ``PRNGKey(0)`` inside ``InferenceEngine``,
+    so every process building the same spec holds identical weights."""
+    import jax.numpy as jnp
+
+    from ..inference import InferenceEngine
+    from ..inference.serving import ServingEngine
+    from ..models.transformer import Model, TransformerConfig
+
+    model_spec = dict(spec.get("model", {}))
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        str(model_spec.pop("dtype", "float32"))]
+    cfg = TransformerConfig(dtype=dtype, **model_spec)
+    engine = InferenceEngine(
+        model=Model(cfg), config={"dtype": spec.get("engine_dtype", "fp32")})
+    return ServingEngine(engine, config=dict(spec.get("serving", {})),
+                         replica_id=replica_id)
+
+
+class WorkerHost:
+    """RPC handler table around one ``ServingEngine`` (see module
+    docstring for the replay-safety rules)."""
+
+    def __init__(self, engine, heartbeat: Optional[str] = None):
+        self.engine = engine
+        self.heartbeat = heartbeat
+        self._hb_last = 0.0
+        self._unacked: list[int] = []  # terminal uids awaiting client ack
+        self._withdrawn: dict[int, dict] = {}  # uid -> encoded request
+        if heartbeat:
+            # beat from a daemon thread, not only between frames: a long
+            # handler (a cold XLA compile inside the first step, a big
+            # drain) blocks the serve loop for longer than any sane
+            # heartbeat timeout, and the supervisor must not SIGKILL a
+            # healthy worker for it. Device compiles/executes release the
+            # GIL, so the thread keeps beating through them; a genuinely
+            # wedged interpreter stops it — which is the hang signal.
+            threading.Thread(target=self._beat_forever, daemon=True).start()
+
+    # -- liveness --------------------------------------------------------
+
+    def tick(self) -> None:
+        if self.heartbeat and time.monotonic() - self._hb_last > 0.2:
+            self._hb_last = time.monotonic()
+            try:
+                os.utime(self.heartbeat, None)
+            except OSError:
+                try:
+                    with open(self.heartbeat, "w"):
+                        pass
+                except OSError:
+                    pass  # heartbeat is advisory; serving goes on
+
+    def _beat_forever(self) -> None:
+        while True:
+            self.tick()
+            time.sleep(0.5)
+
+    def ping(self) -> dict:
+        return {"pid": os.getpid(), "mono": time.monotonic(),
+                "replica_id": self.engine.replica_id}
+
+    # -- scheduler surface ----------------------------------------------
+
+    def _state(self, now=None) -> dict:
+        e = self.engine
+        return {
+            "load": e.load, "idle": e.idle, "queue_len": e.queue_len,
+            "arrived": e.arrived_queue_len(now),
+            "pending": e.pending_arrival_times(),
+        }
+
+    def submit(self, request: dict) -> dict:
+        uid = self.engine.submit(decode_request(request))
+        return {"uid": uid, **self._state()}
+
+    def requeue(self, request: dict) -> dict:
+        req = decode_request(request)
+        self._withdrawn.pop(req.uid, None)  # a re-queued uid may be re-drained
+        try:
+            uid = self.engine.requeue(req)
+        except ValueError as e:
+            if ("already in flight" in str(e)
+                    and self.engine.result(req.uid) is None):
+                uid = req.uid  # replay-safe: a retried requeue re-delivered
+            else:
+                raise
+        return {"uid": uid, **self._state()}
+
+    def withdraw(self, uid: int) -> dict:
+        uid = int(uid)
+        if uid in self._withdrawn:  # replay-safe: reply lost, not the request
+            return {"request": self._withdrawn[uid], **self._state()}
+        req = self.engine.withdraw(uid)
+        enc = None if req is None else encode_request(req)
+        if enc is not None:
+            self._withdrawn[uid] = enc
+        return {"request": enc, **self._state()}
+
+    def cancel(self, uid: int) -> dict:
+        ok = self.engine.cancel(int(uid))
+        res = self.engine.result(int(uid))
+        return {"cancelled": ok,
+                "result": None if res is None else encode_result(res),
+                **self._state()}
+
+    def result(self, uid: int):
+        res = self.engine.result(int(uid))
+        return None if res is None else encode_result(res)
+
+    def step(self, now=None, enforce_deadlines: bool = True,
+             ack=None) -> dict:
+        for uid in ack or []:
+            try:
+                self._unacked.remove(int(uid))
+            except ValueError:
+                pass
+        uids = self.engine.step(
+            now=None if now is None else float(now),
+            enforce_deadlines=bool(enforce_deadlines))
+        known = set(self._unacked)
+        self._unacked.extend(u for u in uids if u not in known)
+        results = {}
+        for u in self._unacked:
+            res = self.engine.result(u)
+            if res is not None:
+                results[str(u)] = encode_result(res)
+        return {
+            "uids": list(self._unacked),
+            "results": results,
+            "trace": self.engine.take_trace_flush(256),
+            "compiled": self.engine.last_step_compiled,
+            **self._state(now),
+        }
+
+    def live_requests(self) -> list:
+        return [encode_request(r) for r in self.engine.live_requests()]
+
+    def arrived_queue_len(self, now=None) -> int:
+        return self.engine.arrived_queue_len(
+            None if now is None else float(now))
+
+    def prefix_match_len(self, prompt) -> int:
+        return self.engine.prefix_match_len(_dec_value(prompt))
+
+    def set_epoch(self, elapsed: float) -> dict:
+        # cross-process epoch alignment: perf_counter references are
+        # per-process, so the wire carries the caller's elapsed-since-epoch
+        # and we re-anchor the local clock to match (skew = rpc latency)
+        self.engine.set_epoch(time.perf_counter() - float(elapsed))
+        return self._state()
+
+    def drain(self) -> dict:
+        return {str(u): encode_result(r)
+                for u, r in self.engine.drain().items()}
+
+    # -- observability ---------------------------------------------------
+
+    def telemetry_snapshot(self) -> dict:
+        return self.engine.telemetry_snapshot()
+
+    def compile_counts(self) -> dict:
+        return self.engine.compile_counts()
+
+    def prefix_cache_stats(self):
+        return self.engine.prefix_cache_stats()
+
+    def handlers(self) -> dict:
+        return {name: getattr(self, name) for name in (
+            "ping", "submit", "requeue", "withdraw", "cancel", "result",
+            "step", "live_requests", "arrived_queue_len", "prefix_match_len",
+            "set_epoch", "drain", "telemetry_snapshot", "compile_counts",
+            "prefix_cache_stats")}
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.launcher.serving_worker",
+        description="Host one ServingEngine replica behind the serving RPC.")
+    ap.add_argument("--socket", required=True, help="unix socket path to bind")
+    ap.add_argument("--spec", required=True,
+                    help="JSON spec file: {model, engine_dtype, serving}")
+    ap.add_argument("--replica-id", default="0",
+                    help="identity stamped into telemetry snapshots")
+    ap.add_argument("--heartbeat", default="",
+                    help="heartbeat file touched each serve-loop tick")
+    args = ap.parse_args(argv)
+
+    with open(args.spec) as f:
+        spec = json.load(f)
+    rid = int(args.replica_id) if str(args.replica_id).isdigit() else args.replica_id
+
+    # SIGTERM/SIGINT -> flag only (resilience/preemption.py); consumed at a
+    # frame boundary below for the drain-then-exit path
+    guard = PreemptionGuard(["SIGTERM", "SIGINT"])
+    guard.install()
+
+    # engine BEFORE socket: a connectable socket means a servable worker
+    engine = build_serving_engine(spec, replica_id=rid)
+    host = WorkerHost(engine, heartbeat=args.heartbeat or None)
+    server = RpcServer(args.socket, host.handlers())
+    print(json.dumps({"event": "ready", "pid": os.getpid(),
+                      "replica_id": rid, "socket": args.socket}), flush=True)
+    try:
+        server.serve_forever(should_stop=guard.pending, on_tick=host.tick)
+    finally:
+        server.close()
+    if guard.pending():
+        # graceful retirement: finish every accepted request in-process so
+        # nothing is stranded mid-decode, then report and exit 0
+        in_flight = engine.load
+        results = engine.drain()
+        print(json.dumps({"event": "drained", "signal": guard.last_signal,
+                          "in_flight_at_signal": in_flight,
+                          "results": len(results)}), flush=True)
+    return 0
+
+
+# -- supervision -------------------------------------------------------------
+
+class WorkerSupervisor:
+    """Spawn/respawn serving worker processes — the elastic agent's
+    heartbeat-timeout/SIGKILL discipline applied to the serving fleet.
+
+    One replica SLOT per worker (slot ids 0..n-1); each (re)spawn is a new
+    generation with a fresh socket path. ``poll()`` detects exited workers
+    and SIGKILLs hung ones (heartbeat stale on a monotonic clock);
+    ``respawn()`` pays the bounded-backoff delay and boots a replacement.
+    The caller wires respawned clients back into a Router via
+    ``Router.attach_replica`` — a replacement process is a NEW replica,
+    never a resurrection of the dead rid."""
+
+    def __init__(self, spec: dict, n_workers: int, *,
+                 workdir: Optional[str] = None,
+                 transport: RouterTransportConfig | dict | None = None,
+                 respawn_backoff: RetryPolicy | dict | None = None,
+                 max_respawns: int = 3,
+                 seed: int = 0,
+                 env: Optional[dict] = None):
+        if isinstance(transport, dict):
+            transport = RouterTransportConfig(**transport)
+        self.transport = transport or RouterTransportConfig()
+        if isinstance(respawn_backoff, dict):
+            respawn_backoff = RetryPolicy(**respawn_backoff)
+        self.respawn_backoff = respawn_backoff or RetryPolicy(
+            max_attempts=1 << 30, base_delay_s=0.5, max_delay_s=8.0,
+            jitter=0.25)
+        self.max_respawns = int(max_respawns)
+        self.seed = int(seed)
+        self.n_workers = int(n_workers)
+        # sockets live here: a caller-supplied deep path can overflow the
+        # AF_UNIX sun_path limit (~108 chars), so default to a short tmpdir
+        self.workdir = workdir or tempfile.mkdtemp(prefix="dstpu_srv_")
+        os.makedirs(self.workdir, exist_ok=True)
+        self.spec_path = os.path.join(self.workdir, "spec.json")
+        with open(self.spec_path, "w") as f:
+            json.dump(spec, f)
+        self.extra_env = dict(env or {})
+        self._procs: dict[int, subprocess.Popen] = {}
+        self._clients: dict[int, ReplicaClient] = {}
+        self._logs: dict[int, str] = {}
+        self._gen: Counter = Counter()
+        self._respawn_count: Counter = Counter()
+        # heartbeat staleness is judged by the shared monotonic judge
+        # (resilience/heartbeat.HeartbeatJudge, same as the elastic
+        # agent): mtime-change observations on a monotonic clock — an NTP
+        # step can't mint a false hung verdict — with a 10x startup grace
+        # until the worker's first touch
+        self._hb_path: dict[int, str] = {}
+        self._hb_judge: dict[int, HeartbeatJudge] = {}
+        self.respawns = 0
+
+    # -- spawn -----------------------------------------------------------
+
+    def _sock_path(self, slot: int) -> str:
+        return os.path.join(self.workdir, f"w{slot}g{self._gen[slot]}.sock")
+
+    def spawn(self, slot: int) -> ReplicaClient:
+        """Boot the worker for ``slot`` and block until its socket serves a
+        ping (bounded by ``transport.boot_timeout_s``)."""
+        sock = self._sock_path(slot)
+        hb = os.path.join(self.workdir, f"hb{slot}")
+        with open(hb, "w"):
+            pass
+        self._hb_path[slot] = hb
+        judge = HeartbeatJudge(hb, float(self.transport.heartbeat_timeout_s))
+        judge.reset()
+        self._hb_judge[slot] = judge
+        log_path = os.path.join(self.workdir,
+                                f"w{slot}g{self._gen[slot]}.log")
+        self._logs[slot] = log_path
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.serving_worker",
+               "--socket", sock, "--spec", self.spec_path,
+               "--replica-id", str(slot), "--heartbeat", hb]
+        with open(log_path, "w") as log_f:
+            proc = subprocess.Popen(cmd, env=env, stdout=log_f,
+                                    stderr=subprocess.STDOUT,
+                                    start_new_session=True)
+        self._procs[slot] = proc
+        client = ReplicaClient(sock, replica_id=slot,
+                               transport=self.transport,
+                               seed=self.seed * 1009 + slot)
+        deadline = time.monotonic() + float(self.transport.boot_timeout_s)
+        while True:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"serving worker slot {slot} exited rc={proc.returncode} "
+                    f"during boot (log: {log_path}): {self.log_tail(slot)}")
+            try:
+                client.connect()
+                client.ping()
+                break
+            except RpcConnectionLost:
+                if time.monotonic() > deadline:
+                    proc.kill()
+                    raise RuntimeError(
+                        f"serving worker slot {slot} did not serve within "
+                        f"boot_timeout_s={self.transport.boot_timeout_s} "
+                        f"(log: {log_path})") from None
+                time.sleep(0.1)
+        self._clients[slot] = client
+        logger.info("serving supervisor: slot %d generation %d up (pid %d)",
+                    slot, self._gen[slot], proc.pid)
+        return client
+
+    def start(self) -> list[ReplicaClient]:
+        return [self.spawn(slot) for slot in range(self.n_workers)]
+
+    def client(self, slot: int) -> ReplicaClient:
+        return self._clients[slot]
+
+    def proc(self, slot: int) -> subprocess.Popen:
+        return self._procs[slot]
+
+    def log_tail(self, slot: int, lines: int = 5) -> str:
+        try:
+            with open(self._logs[slot]) as f:
+                return " | ".join(f.read().strip().splitlines()[-lines:])
+        except OSError:
+            return "<no log>"
+
+    # -- liveness --------------------------------------------------------
+
+    def _heartbeat_stale(self, slot: int) -> bool:
+        judge = self._hb_judge.get(slot)
+        return judge is not None and judge.stale()
+
+    def poll(self) -> list[int]:
+        """One supervision pass: slots whose worker exited, plus slots
+        whose heartbeat went stale (those are SIGKILL'd first — a wedged
+        worker already ignored its chance to exit). Returns the slots that
+        now need ``respawn()``."""
+        bad = []
+        for slot, proc in list(self._procs.items()):
+            if proc.poll() is not None:
+                bad.append(slot)
+            elif self._heartbeat_stale(slot):
+                logger.warning(
+                    "serving supervisor: slot %d heartbeat stale >%.1fs — "
+                    "SIGKILL", slot, self.transport.heartbeat_timeout_s)
+                proc.kill()
+                proc.wait()
+                bad.append(slot)
+        return bad
+
+    def respawn(self, slot: int) -> ReplicaClient:
+        """Replace a dead/hung worker: pay the bounded-backoff delay for
+        this slot's respawn count, then spawn a fresh generation. Raises
+        once ``max_respawns`` for the slot is exhausted (a crash-looping
+        spec must surface, not spin)."""
+        self._respawn_count[slot] += 1
+        if self._respawn_count[slot] > self.max_respawns:
+            raise RuntimeError(
+                f"serving worker slot {slot} exhausted its respawn budget "
+                f"({self.max_respawns}); last log: {self.log_tail(slot)}")
+        proc = self._procs.get(slot)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        old = self._clients.pop(slot, None)
+        if old is not None:
+            old.close()
+        delay = backoff_delay(self._respawn_count[slot], self.respawn_backoff,
+                              seed=self.seed * 7919 + slot)
+        if delay > 0:
+            time.sleep(delay)
+        self._gen[slot] += 1
+        self.respawns += 1
+        return self.spawn(slot)
+
+    def kill(self, slot: int, sig: int = signal.SIGKILL) -> None:
+        """Deliver ``sig`` to the slot's worker (the chaos drill's kill -9)."""
+        os.kill(self._procs[slot].pid, sig)
+
+    def shutdown(self, sig: int = signal.SIGTERM, timeout: float = 10.0) -> None:
+        for slot, proc in self._procs.items():
+            if proc.poll() is None:
+                try:
+                    os.kill(proc.pid, sig)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + timeout
+        for proc in self._procs.values():
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        for client in self._clients.values():
+            client.close()
+        self._clients.clear()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

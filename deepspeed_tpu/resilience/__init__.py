@@ -27,6 +27,11 @@ from .errors import (
     PreemptionSignal,
     RequestRejected,
     ResilienceError,
+    RpcConnectionLost,
+    RpcError,
+    RpcGarbledFrame,
+    RpcRemoteError,
+    RpcTimeout,
     TrainingDivergedError,
     PermanentIOError,
     TransientIOError,
@@ -39,6 +44,7 @@ from .faults import (
     maybe_io_error,
 )
 from .guardrails import TrainingGuardrail
+from .heartbeat import HeartbeatJudge
 from .preemption import PreemptionGuard
 from .retry import RetryPolicy, backoff_delay, retry_call
 
@@ -47,11 +53,17 @@ __all__ = [
     "CheckpointError",
     "CheckpointNotFoundError",
     "FaultInjector",
+    "HeartbeatJudge",
     "PreemptionGuard",
     "PreemptionSignal",
     "RequestRejected",
     "ResilienceError",
     "RetryPolicy",
+    "RpcConnectionLost",
+    "RpcError",
+    "RpcGarbledFrame",
+    "RpcRemoteError",
+    "RpcTimeout",
     "TrainingDivergedError",
     "TrainingGuardrail",
     "PermanentIOError",

@@ -75,6 +75,42 @@ class PermanentIOError(OSError):
     on attempt 2 (indistinguishable from ``io_flaky``)."""
 
 
+class RpcError(ResilienceError):
+    """Base class for serving-RPC transport failures (``inference/rpc.py``).
+    Stdlib-only like every other typed error here — the Router and the
+    worker supervisor branch on the failure *kind*: a timeout is a HUNG
+    verdict (the call may have executed; the reply never arrived in
+    budget), a lost connection or garbled stream is a DEAD one."""
+
+
+class RpcTimeout(RpcError):
+    """The per-call deadline elapsed before a complete reply frame arrived.
+    The remote side may or may not have executed the call — callers must
+    treat the outcome as unknown (the Router's exactly-once failover and
+    the worker's cumulative unacked-terminal buffer both exist for this)."""
+
+
+class RpcConnectionLost(RpcError):
+    """The transport connection failed (refused, reset, or peer closed) —
+    a SIGKILL'd worker process manifests as exactly this on the next
+    call."""
+
+
+class RpcGarbledFrame(RpcError):
+    """A frame failed the magic/CRC check: the byte stream is corrupt or
+    desynchronized. The connection is unusable and is closed; a reconnect
+    starts a fresh stream."""
+
+
+class RpcRemoteError(RpcError):
+    """The remote handler raised an exception that has no typed local
+    mapping; carries the remote type name for logs/tests."""
+
+    def __init__(self, remote_type: str, message: str):
+        super().__init__(f"remote {remote_type}: {message}")
+        self.remote_type = remote_type
+
+
 class RequestRejected(ResilienceError):
     """Serving load-shed verdict: the request was refused admission instead
     of growing the arrival queue without bound. ``reason`` is a stable typed

@@ -38,6 +38,18 @@ Fault sites (see docs/resilience.md for where each is wired):
                       at a chosen router step (the verdict path — the step
                       itself completes in-process; the Router treats the
                       synthetic latency as a hung heartbeat).
+  ``rpc_timeout``     the Nth RPC call of a given method never sees its
+                      reply inside the per-call deadline (the call HAS
+                      executed remotely — the client raises ``RpcTimeout``
+                      after receiving and discarding the reply, modelling
+                      a reply that arrived too late; inference/rpc.py).
+  ``rpc_conn_reset``  the connection drops after the Nth call of a method
+                      executes (reply discarded, socket closed —
+                      ``RpcConnectionLost``; the next call pays the
+                      bounded-backoff reconnect).
+  ``rpc_garbled_frame``  the Nth reply frame of a method fails the
+                      magic/CRC check (``RpcGarbledFrame``; the stream is
+                      desynchronized, so the socket is closed too).
 
 Two selection modes compose:
 
@@ -73,7 +85,8 @@ class FaultInjector:
     keys, or None (disabled)."""
 
     SITES = ("nan_grads", "io_error", "io_flaky", "garbage_logits", "preempt",
-             "replica_dead", "replica_hang")
+             "replica_dead", "replica_hang",
+             "rpc_timeout", "rpc_conn_reset", "rpc_garbled_frame")
 
     def __init__(self, cfg: Any = None):
         self.enabled = bool(_get(cfg, "enabled", False)) if cfg is not None else False
@@ -93,6 +106,14 @@ class FaultInjector:
                                 for p in _get(cfg, "replica_dead_at", []) or []}
         self.replica_hang_at = {tuple(int(x) for x in p)
                                 for p in _get(cfg, "replica_hang_at", []) or []}
+        # rpc transport faults: [method, nth-call-of-that-method] pairs
+        # (1-based, per-client per-method call clocks — inference/rpc.py)
+        self.rpc_timeout_at = {(str(p[0]), int(p[1]))
+                               for p in _get(cfg, "rpc_timeout_at", []) or []}
+        self.rpc_conn_reset_at = {(str(p[0]), int(p[1]))
+                                  for p in _get(cfg, "rpc_conn_reset_at", []) or []}
+        self.rpc_garbled_at = {(str(p[0]), int(p[1]))
+                               for p in _get(cfg, "rpc_garbled_at", []) or []}
         self._writes = 0  # guarded-write clock (io_error site)
         self._fired: set = set()  # list-mode keys fire exactly once
         self._lock = threading.Lock()
@@ -197,6 +218,33 @@ class FaultInjector:
         return self._fire("replica_hang",
                           (replica, step) in self.replica_hang_at,
                           (replica, step))
+
+    def rpc_timeout(self, method: str, call_n: int) -> bool:
+        """True if the ``call_n``-th RPC call of ``method`` (1-based, per
+        client) should lose its reply to a deadline."""
+        if not self.enabled:
+            return False
+        return self._fire("rpc_timeout",
+                          (method, call_n) in self.rpc_timeout_at,
+                          (method, call_n))
+
+    def rpc_conn_reset(self, method: str, call_n: int) -> bool:
+        """True if the connection should reset after the ``call_n``-th call
+        of ``method`` executes."""
+        if not self.enabled:
+            return False
+        return self._fire("rpc_conn_reset",
+                          (method, call_n) in self.rpc_conn_reset_at,
+                          (method, call_n))
+
+    def rpc_garbled_frame(self, method: str, call_n: int) -> bool:
+        """True if the ``call_n``-th reply frame of ``method`` should fail
+        its integrity check."""
+        if not self.enabled:
+            return False
+        return self._fire("rpc_garbled_frame",
+                          (method, call_n) in self.rpc_garbled_at,
+                          (method, call_n))
 
     def stats(self) -> dict:
         return {

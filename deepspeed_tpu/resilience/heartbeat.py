@@ -1,0 +1,64 @@
+"""Monotonic heartbeat staleness: one verdict clock for every supervisor.
+
+Both process supervisors in this codebase (the elastic training agent and
+the serving ``WorkerSupervisor``) judge worker liveness by a heartbeat
+FILE the worker touches. The judge here encodes the two rules they must
+share:
+
+  * staleness is ``time.monotonic()`` elapsed between the supervisor's own
+    observations of the file's mtime CHANGING — never ``time.time() -
+    mtime`` arithmetic. mtime is a wall-clock stamp: an NTP step (or a
+    skewed filesystem clock) could otherwise mint a false hung verdict and
+    SIGKILL a healthy worker, or stretch a real hang's detection window.
+  * until the worker's FIRST touch, the clock is a startup ``grace``
+    (default 10x the timeout), not the steady-state ``timeout`` —
+    time-to-first-touch includes interpreter boot and cold XLA compiles,
+    and a step-cadence timeout would kill a healthy worker that is still
+    compiling.
+
+Stdlib-only, like the rest of resilience/.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class HeartbeatJudge:
+    """Staleness verdict over one heartbeat file. ``reset()`` right after
+    (re)creating the file at worker launch; ``stale()`` on every
+    supervision poll. ``timeout <= 0`` disarms the judge entirely."""
+
+    def __init__(self, path: str, timeout: float, grace: float | None = None):
+        self.path = str(path)
+        self.timeout = float(timeout)
+        self.grace = float(grace) if grace is not None else 10.0 * self.timeout
+        self._created_mtime = 0.0
+        self._launch = 0.0
+        self._obs = (0.0, 0.0)  # (mtime, monotonic-at-observation)
+
+    def reset(self) -> None:
+        """Start a fresh generation's clock (the file was just created)."""
+        self._created_mtime = os.path.getmtime(self.path)
+        self._launch = time.monotonic()
+        self._obs = (self._created_mtime, self._launch)
+
+    def stale(self) -> bool:
+        if self.timeout <= 0:
+            return False
+        try:
+            mtime = os.path.getmtime(self.path)
+        except OSError:  # deleted from under us: treat as stale
+            return True
+        last_mtime, last_mono = self._obs
+        if mtime != last_mtime:
+            self._obs = (mtime, time.monotonic())
+            return False
+        if mtime == self._created_mtime:
+            # never touched: still booting/compiling — grace clock
+            return time.monotonic() - self._launch > self.grace
+        return time.monotonic() - last_mono > self.timeout
+
+
+__all__ = ["HeartbeatJudge"]

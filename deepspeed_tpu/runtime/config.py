@@ -311,6 +311,11 @@ class FaultInjectionConfig:
       pairs (1-based steps) at which a serving Router replica is found dead
       before its step, or its step is observed past ``health.timeout``
       (inference/router.py consumes these; engines ignore them).
+    - ``rpc_timeout_at`` / ``rpc_conn_reset_at`` / ``rpc_garbled_at``:
+      ``[method, nth_call]`` pairs (1-based per-client per-method call
+      clocks) at which the serving RPC transport loses a reply to its
+      deadline, drops the connection after the call executes, or corrupts
+      the reply frame (``inference/rpc.py`` consumes these client-side).
     - ``rate`` in [0, 1] with optional ``sites`` allowlist
       (``nan_grads`` | ``io_error`` | ``io_flaky`` | ``garbage_logits`` |
       ``preempt`` | ``replica_dead`` | ``replica_hang``).
@@ -329,6 +334,9 @@ class FaultInjectionConfig:
     preempt_steps: list = field(default_factory=list)
     replica_dead_at: list = field(default_factory=list)
     replica_hang_at: list = field(default_factory=list)
+    rpc_timeout_at: list = field(default_factory=list)
+    rpc_conn_reset_at: list = field(default_factory=list)
+    rpc_garbled_at: list = field(default_factory=list)
 
     def __post_init__(self):
         if not 0.0 <= self.rate <= 1.0:
@@ -340,7 +348,9 @@ class FaultInjectionConfig:
                 f"got {self.garbage_logits_phase!r}")
         bad = set(self.sites) - {"nan_grads", "io_error", "io_flaky",
                                  "garbage_logits", "preempt",
-                                 "replica_dead", "replica_hang"}
+                                 "replica_dead", "replica_hang",
+                                 "rpc_timeout", "rpc_conn_reset",
+                                 "rpc_garbled_frame"}
         if bad:
             raise DeepSpeedConfigError(
                 f"fault_injection.sites contains unknown site(s) {sorted(bad)}")
@@ -351,6 +361,14 @@ class FaultInjectionConfig:
                     raise DeepSpeedConfigError(
                         f"fault_injection.{name} entries must be "
                         f"[replica_id, router_step] int pairs, got {p!r}")
+        for name in ("rpc_timeout_at", "rpc_conn_reset_at", "rpc_garbled_at"):
+            for p in getattr(self, name):
+                if (not isinstance(p, (list, tuple)) or len(p) != 2
+                        or not isinstance(p[0], str)
+                        or not isinstance(p[1], int)):
+                    raise DeepSpeedConfigError(
+                        f"fault_injection.{name} entries must be "
+                        f"[method, nth_call] (str, int) pairs, got {p!r}")
 
 
 @dataclass
@@ -590,6 +608,64 @@ class RouterHealthConfig:
 
 
 @dataclass
+class RouterTransportConfig:
+    """``serving.router.transport`` block (consumed by
+    ``inference/rpc.ReplicaClient`` + ``launcher/serving_worker.
+    WorkerSupervisor``; docs/serving.md "Process-mode deployment").
+
+    Governs the RPC transport when replicas are worker processes (in-process
+    replicas never touch it):
+
+    - ``call_timeout_s``: per-call reply deadline. A ``step()`` that misses
+      it surfaces as ``RpcTimeout`` — the Router's HUNG verdict (the call
+      may have executed; the outcome is unknown).
+    - ``connect_attempts`` / ``base_delay_s`` / ``max_delay_s`` / ``jitter``:
+      the reconnect schedule, field-compatible with ``resilience.retry``'s
+      ``RetryPolicy`` (``backoff_delay`` consumes it directly). A client
+      whose connection dropped pays this bounded backoff on the next call.
+    - ``boot_timeout_s``: how long the supervisor waits for a freshly
+      spawned worker's socket to accept (covers interpreter + engine boot
+      and cold XLA compiles).
+    - ``heartbeat_timeout_s``: worker heartbeat-file staleness (judged on a
+      monotonic clock) past which the supervisor SIGKILLs and respawns;
+      0 disables heartbeat supervision (process exit is still detected).
+    """
+
+    call_timeout_s: float = 30.0
+    connect_attempts: int = 4
+    base_delay_s: float = 0.2
+    max_delay_s: float = 2.0
+    jitter: float = 0.25
+    boot_timeout_s: float = 60.0
+    heartbeat_timeout_s: float = 10.0
+
+    def __post_init__(self):
+        if self.call_timeout_s <= 0:
+            raise DeepSpeedConfigError(
+                f"serving.router.transport.call_timeout_s must be > 0, "
+                f"got {self.call_timeout_s}")
+        if self.connect_attempts < 1:
+            raise DeepSpeedConfigError(
+                f"serving.router.transport.connect_attempts must be >= 1, "
+                f"got {self.connect_attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise DeepSpeedConfigError(
+                "serving.router.transport delays must be >= 0")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise DeepSpeedConfigError(
+                f"serving.router.transport.jitter must be in [0, 1], "
+                f"got {self.jitter}")
+        if self.boot_timeout_s <= 0:
+            raise DeepSpeedConfigError(
+                f"serving.router.transport.boot_timeout_s must be > 0, "
+                f"got {self.boot_timeout_s}")
+        if self.heartbeat_timeout_s < 0:
+            raise DeepSpeedConfigError(
+                f"serving.router.transport.heartbeat_timeout_s must be "
+                f">= 0, got {self.heartbeat_timeout_s}")
+
+
+@dataclass
 class RouterConfig:
     """``serving.router`` block (consumed by ``inference/router.Router``;
     docs/serving.md "Multi-replica router").
@@ -605,16 +681,22 @@ class RouterConfig:
       ``RequestRejected(reason="queue_full")``. 0 = unbounded. Per-replica
       ``serving.max_queue_len`` still applies underneath.
     - ``health``: liveness/probation sub-block (its own dataclass above).
+    - ``transport``: RPC transport sub-block for process-mode replicas
+      (its own dataclass above; ignored by in-process fleets).
     """
 
     replicas: int = 1
     affinity: bool = True
     max_queue_len: int = 0
     health: RouterHealthConfig = field(default_factory=RouterHealthConfig)
+    transport: RouterTransportConfig = field(
+        default_factory=RouterTransportConfig)
 
     def __post_init__(self):
         if isinstance(self.health, dict):
             self.health = _build(RouterHealthConfig, self.health)
+        if isinstance(self.transport, dict):
+            self.transport = _build(RouterTransportConfig, self.transport)
         if self.replicas < 1:
             raise DeepSpeedConfigError(
                 f"serving.router.replicas must be >= 1, got {self.replicas}")

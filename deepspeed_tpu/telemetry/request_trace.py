@@ -51,6 +51,7 @@ class RequestTracer:
         self.replica_id = replica_id
         self._clock = clock  # () -> epoch-relative seconds; None = caller passes t
         self._buf: deque[dict] = deque(maxlen=self.capacity)
+        self._seq = 0  # total events ever recorded (ring evicts, seq doesn't)
 
     def record(self, uid: int, event: str, t: float | None = None, **attrs) -> None:
         if t is None and self._clock is not None:
@@ -60,12 +61,34 @@ class RequestTracer:
             ev["replica_id"] = self.replica_id
         ev.update(attrs)
         self._buf.append(ev)
+        self._seq += 1
 
     def events(self, uid: int | None = None) -> list[dict]:
         """Buffered events (oldest first), optionally for one uid."""
         if uid is None:
             return [dict(ev) for ev in self._buf]
         return [dict(ev) for ev in self._buf if ev["uid"] == uid]
+
+    @property
+    def seq(self) -> int:
+        """Monotone count of events ever recorded — the cursor space for
+        ``events_since``."""
+        return self._seq
+
+    def events_since(self, cursor: int, limit: int = 256) -> tuple[list[dict], int]:
+        """Events recorded after ``cursor`` (a previous return's second
+        element; 0 = from the start), at most ``limit`` of the OLDEST
+        pending ones — the incremental-flush primitive: a serving worker
+        piggybacks these on every ``step()`` reply so a replica that is
+        later SIGKILL'd has already shipped its timeline to the router.
+        Events evicted from the ring before being read are lost (the flush
+        is bounded, not guaranteed). Returns ``(events, new_cursor)``."""
+        buf = self._buf
+        # buffer holds seq range [self._seq - len(buf), self._seq)
+        skip = max(0, len(buf) - max(0, self._seq - int(cursor)))
+        out = [dict(ev) for i, ev in enumerate(buf)
+               if skip <= i < skip + max(0, int(limit))]
+        return out, self._seq - max(0, len(buf) - skip - len(out))
 
     def __len__(self) -> int:
         return len(self._buf)

@@ -470,9 +470,11 @@ def test_heartbeat_startup_grace_vs_step_timeout(tmp_path):
         static_world_size=4,
         heartbeat_file=str(hb), heartbeat_timeout=0.2, heartbeat_grace=30.0)
     # simulate _launch's bookkeeping without spawning a worker
+    from deepspeed_tpu.resilience.heartbeat import HeartbeatJudge
+
     hb.write_text("")
-    agent._hb_launch = time.time()
-    agent._hb_created_mtime = os.path.getmtime(hb)
+    agent._hb_judge = HeartbeatJudge(str(hb), 0.2, 30.0)
+    agent._hb_judge.reset()
     time.sleep(0.3)  # past the step timeout, inside the startup grace
     assert not agent._heartbeat_stale()  # never touched: still compiling
     hb.touch()  # first worker heartbeat: step clock takes over
@@ -483,3 +485,43 @@ def test_heartbeat_startup_grace_vs_step_timeout(tmp_path):
     assert DSElasticAgent(
         ELASTIC_CFG, WorkerSpec(command=["true"]), static_world_size=4,
         heartbeat_timeout=2.0).heartbeat_grace == 20.0
+
+
+def test_heartbeat_staleness_never_consults_wall_clock(tmp_path, monkeypatch):
+    """Regression (PR 9 satellite): staleness used to be judged by
+    ``time.time() - mtime``, so an NTP step could SIGKILL a healthy worker
+    (false hang) or hide a real one. The verdict clock is now monotonic
+    observations of the mtime CHANGING — proven by replacing the agent
+    module's wall clock with one that raises and running the full
+    grace -> touch -> quiet -> stale cycle."""
+    import time as _time
+
+    from deepspeed_tpu.elasticity import elastic_agent as agent_mod
+    from deepspeed_tpu.resilience import heartbeat as hb_mod
+    from deepspeed_tpu.resilience.heartbeat import HeartbeatJudge
+
+    class _NoWallClock:
+        def __getattr__(self, name):
+            return getattr(_time, name)
+
+        @staticmethod
+        def time():
+            raise AssertionError(
+                "time.time() consulted in the heartbeat verdict path")
+
+    hb = tmp_path / "hb"
+    agent = DSElasticAgent(
+        ELASTIC_CFG, WorkerSpec(command=[sys.executable, "-c", "pass"]),
+        static_world_size=4,
+        heartbeat_file=str(hb), heartbeat_timeout=0.2, heartbeat_grace=30.0)
+    hb.write_text("")
+    agent._hb_judge = HeartbeatJudge(str(hb), 0.2, 30.0)
+    agent._hb_judge.reset()
+    monkeypatch.setattr(agent_mod, "time", _NoWallClock())
+    monkeypatch.setattr(hb_mod, "time", _NoWallClock())
+    time.sleep(0.3)
+    assert not agent._heartbeat_stale()  # startup grace, no wall clock
+    hb.touch()
+    assert not agent._heartbeat_stale()  # fresh touch observed
+    time.sleep(0.3)
+    assert agent._heartbeat_stale()  # quiet past the timeout: a real hang

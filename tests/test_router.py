@@ -357,6 +357,85 @@ def test_cancel_duplicate_uid_and_drain_edge_cases(engine):
         res[0].tokens, engine.generate(prompts[0][None], 8)[0])
 
 
+def test_drain_interleaved_with_sibling_death(engine):
+    """Satellite drill: replica B dies mid-decode, then replica A is
+    drained while the fleet is still recovering. Zero accepted requests
+    lost, B's in-flight work fails over exactly once, and A's drain
+    migration never targets the dead replica (its ``accepts`` gate is
+    down) — every completion keeps solo-generate parity."""
+    prompts = _prompts([5, 11, 23, 9, 17, 6], seed=17)
+    router = _router(engine, replicas=3, fi={"replica_dead_at": [[1, 3]]})
+    for i, p in enumerate(prompts):
+        router.submit(Request(uid=i, prompt=p, max_new_tokens=8))
+    on_b = [u for u in range(6) if router.owner_of(u) == 1]
+    assert on_b  # least-loaded spread put work on the doomed replica
+    router.step(now=0.0)
+    router.step(now=0.0)  # everyone decoding
+    router.step(now=0.0)  # injected replica_dead on B -> failover
+    assert router.replica_states()[1] == "dead"
+    # drain A mid-recovery, with a queued backlog to force migration
+    extra = _prompts([5, 9], seed=18)
+    for j, p in enumerate(extra):
+        router.submit(Request(uid=10 + j, prompt=p, max_new_tokens=4))
+    router.drain_replica(0, block=False)
+    migrated = [u for u, rid in router._owner.items()
+                if rid != 0 and 0 in router._seen.get(u, set())]
+    for u in migrated:
+        # drain-migrated uids never land on the dead replica
+        assert router.owner_of(u) == 2, (u, router.owner_of(u))
+    res = router.drain()
+    assert router.replica_states()[0] == "drained"
+    for i, p in enumerate(prompts):
+        assert res[i].ok, (i, res[i].status)
+        np.testing.assert_array_equal(res[i].tokens,
+                                      engine.generate(p[None], 8)[0])
+    for j, p in enumerate(extra):
+        assert res[10 + j].ok
+        np.testing.assert_array_equal(res[10 + j].tokens,
+                                      engine.generate(p[None], 4)[0])
+    counters = router.telemetry.registry.snapshot()["counters"]
+    assert counters["router/failovers"] == len(on_b)  # exactly once each
+    assert counters.get("router/failed_requests", 0) == 0
+    assert router.router_stats()["failovers_recovered"] == len(on_b)
+
+
+def test_verdict_clocks_never_consult_wall_clock(engine, monkeypatch):
+    """Satellite regression: the router's heartbeat/probation clocks are
+    monotonic (perf_counter) — an NTP step must not mint a false HUNG
+    verdict or stretch a probation window. Proven by replacing the router
+    module's wall clock with one that raises: the full hang -> probation
+    -> readmission cycle still runs."""
+    import time as _time
+
+    from deepspeed_tpu.inference import router as router_mod
+
+    class _NoWallClock:
+        def __getattr__(self, name):
+            return getattr(_time, name)
+
+        @staticmethod
+        def time():
+            raise AssertionError(
+                "time.time() consulted in a router verdict path")
+
+    prompts = _prompts([5, 11], seed=19)
+    router = _router(
+        engine, fi={"replica_hang_at": [[0, 2]]},
+        **{"router": {"replicas": 2,
+                      "health": {"timeout": 5.0, "max_attempts": 3,
+                                 "base_delay_s": 1.0, "jitter": 0.0}}})
+    monkeypatch.setattr(router_mod, "time", _NoWallClock())
+    for i, p in enumerate(prompts):
+        router.submit(Request(uid=i, prompt=p, max_new_tokens=4))
+    router.step(now=0.0)
+    router.step(now=0.0)  # injected hang -> verdict, on a monotonic clock
+    assert router.replica_states()[0] == "probation"
+    router.step(now=1.5)  # backoff elapsed on the router's own clock
+    assert router.replica_states()[0] == "healthy"
+    res = router.drain()
+    assert res[0].ok and res[1].ok
+
+
 def test_router_config_schema_roundtrip():
     """serving.router parses through the typed config tree (host-only)."""
     from deepspeed_tpu.runtime.config import DeepSpeedConfig
