@@ -1,0 +1,17 @@
+"""deepspeed_tpu.analysis — dstpu-lint, the project-native invariant
+checker (docs/analysis.md).
+
+Stdlib-only and self-contained: nothing here imports the parent package,
+so ``bin/dstpu_lint`` can load this directory by file path and run on
+machines without jax. Import surface:
+
+    from deepspeed_tpu.analysis import run_lint, RULES, Finding
+    result = run_lint("deepspeed_tpu")
+    assert result.clean, result.findings
+"""
+
+from . import checkers, cli, drift  # noqa: F401  (rules register on import)
+from .core import RULES, Finding, LintResult, run_lint  # noqa: F401
+
+__all__ = ["RULES", "Finding", "LintResult", "run_lint",
+           "checkers", "drift", "cli"]

@@ -1,0 +1,318 @@
+"""File-scope checkers: the AST invariants PRs 4–8 paid for.
+
+Each rule's docstring-of-record (rule id → invariant → motivating
+incident) lives in docs/analysis.md; the one-liners here are what
+``--list-rules`` prints. All checkers are single AST passes over one file
+— no imports of the linted code, no type inference — so the whole package
+lints in well under a second.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from typing import Iterable, Optional
+
+from .core import Finding, PyFile, rule
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` -> 'c'; `c` -> 'c'; anything else -> None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` -> 'a'; `c` -> 'c'."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk_same_scope(node: ast.AST) -> Iterable[ast.AST]:
+    """Like ast.walk but does not descend into nested function/lambda
+    bodies — code in a nested def runs LATER, not inside the construct
+    being analysed (a lock body, a with block)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _enclosing_functions(tree: ast.AST) -> list[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _innermost_function(funcs: list[ast.AST], lineno: int) -> Optional[ast.AST]:
+    best = None
+    for fn in funcs:
+        end = getattr(fn, "end_lineno", fn.lineno)
+        if fn.lineno <= lineno <= end:
+            if best is None or fn.lineno > best.lineno:
+                best = fn
+    return best
+
+
+# ---------------------------------------------------------------------------
+# wall-clock-verdict — PR 8: an NTP step once minted a false hung verdict
+
+
+_VERDICT_DIRS = ("resilience", "elasticity", "inference", "launcher")
+
+
+@rule("wall-clock-verdict",
+      "time.time() is a wall clock — verdict/staleness/timeout logic must "
+      "use time.monotonic() or resilience/heartbeat.HeartbeatJudge (PR 8 "
+      "NTP-step incident); pragma genuinely-wall-clock sites (timestamps)")
+def check_wall_clock(pf: PyFile) -> list[Finding]:
+    # resolve what `time` and `time.time` are bound to in this module so
+    # `import time as t; t.time()` and `from time import time` both flag
+    time_mods = set()
+    time_fns = set()
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_mods.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time":
+                for a in node.names:
+                    if a.name == "time":
+                        time_fns.add(a.asname or "time")
+    if not time_mods and not time_fns:
+        return []
+    in_verdict_dir = any(f"/{d}/" in pf.rel.replace("\\", "/")
+                         for d in _VERDICT_DIRS)
+    hint = ("this is a verdict-path module — use time.monotonic() or "
+            "HeartbeatJudge" if in_verdict_dir else
+            "use time.monotonic() for any timeout/staleness comparison; "
+            "pragma with a rationale if wall-clock is the point")
+    out = []
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (isinstance(f, ast.Attribute) and f.attr == "time"
+               and isinstance(f.value, ast.Name) and f.value.id in time_mods)
+        hit = hit or (isinstance(f, ast.Name) and f.id in time_fns)
+        if hit:
+            out.append(Finding("wall-clock-verdict", pf.rel, node.lineno,
+                               f"time.time() call — {hint}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# broad-except — PR 4/8: opaque handlers swallowed typed failure kinds
+
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(expr: Optional[ast.AST]) -> bool:
+    if expr is None:  # bare `except:`
+        return True
+    if isinstance(expr, ast.Name) and expr.id in _BROAD:
+        return True
+    if isinstance(expr, ast.Attribute) and expr.attr in _BROAD:
+        return True
+    if isinstance(expr, ast.Tuple):
+        return any(_is_broad(e) for e in expr.elts)
+    return False
+
+
+# stdlib imports never need an environment probe — `try: import json` in a
+# try block doing real work must not exempt that block's broad handlers
+_STDLIB = frozenset(getattr(sys, "stdlib_module_names", ()))
+
+
+def _is_probe_import(n: ast.AST) -> bool:
+    if isinstance(n, ast.Import):
+        return any(a.name.split(".")[0] not in _STDLIB for a in n.names)
+    if isinstance(n, ast.ImportFrom):
+        # relative imports probe optional project modules (native ops)
+        return n.level > 0 or (n.module or "").split(".")[0] not in _STDLIB
+    # dynamic importlib.import_module(mod) is probe-shaped by construction
+    return (isinstance(n, ast.Call)
+            and _terminal_name(n.func) == "import_module")
+
+
+@rule("broad-except",
+      "bare/`except Exception` handlers must re-raise or map to a typed "
+      "resilience/errors.py exception; import/feature probes are exempt; "
+      "deliberate catch-alls (supervisor loops, teardown) carry a pragma")
+def check_broad_except(pf: PyFile) -> list[Finding]:
+    out = []
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            continue
+        # import/feature-probe idiom: `try: import x ...` over a NON-stdlib
+        # module is legitimately broad — optional backends fail with
+        # environment-specific types
+        probe = any(_is_probe_import(n)
+                    for stmt in node.body for n in ast.walk(stmt))
+        for handler in node.handlers:
+            if not _is_broad(handler.type):
+                continue
+            if probe:
+                continue
+            # a Raise anywhere in the handler covers both re-raise and
+            # map-to-typed; nested defs excluded (deferred, not handling)
+            if any(isinstance(n, ast.Raise)
+                   for n in _walk_same_scope(handler)):
+                continue
+            what = ("bare except:" if handler.type is None else
+                    f"except {ast.unparse(handler.type)}")
+            out.append(Finding(
+                "broad-except", pf.rel, handler.lineno,
+                f"{what} neither re-raises nor maps to a typed exception — "
+                f"narrow it, or pragma a deliberate catch-all with its "
+                f"rationale"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock — PR 6/8: the router/RPC/supervisor thread code must
+# never stall the fleet while holding a lock
+
+
+_BLOCKING_CALLS = {"sleep", "recv", "recv_into", "recvfrom", "accept",
+                   "block_until_ready"}
+
+
+def _lockish(expr: ast.AST) -> bool:
+    name = _terminal_name(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = _terminal_name(expr.func)  # with threading.Lock(): ...
+    return name is not None and "lock" in name.lower()
+
+
+@rule("blocking-under-lock",
+      "time.sleep / socket recv/accept / subprocess.* / block_until_ready "
+      "lexically inside a `with <lock>:` body is a stall/deadlock hazard "
+      "(router, RPC and supervisor threads share these locks)")
+def check_blocking_under_lock(pf: PyFile) -> list[Finding]:
+    out = []
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lock_item = next((item.context_expr for item in node.items
+                          if _lockish(item.context_expr)), None)
+        if lock_item is None:
+            continue
+        lock_src = ast.unparse(lock_item)
+        for inner in _walk_same_scope(node):
+            if not isinstance(inner, ast.Call):
+                continue
+            name = _terminal_name(inner.func)
+            blocked = name in _BLOCKING_CALLS
+            blocked = blocked or _root_name(inner.func) == "subprocess"
+            if blocked:
+                out.append(Finding(
+                    "blocking-under-lock", pf.rel, inner.lineno,
+                    f"{ast.unparse(inner.func)}(...) inside `with "
+                    f"{lock_src}:` — a blocked holder stalls every waiter; "
+                    f"move the blocking call outside the critical section"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# unguarded-donation — PR 4 root cause: donation of zero-copy host buffers
+# on the CPU backend is silent use-after-free
+
+
+_DONATION_KWARGS = ("donate_argnums", "donate_argnames")
+_SANCTIONED_CALLEE = "donated_jit"
+_HELPER_MODULE = "utils/donation.py"
+
+
+@rule("unguarded-donation",
+      "donate_argnums/donate_argnames must route through "
+      "utils/donation.donated_jit — the one audited place that knows the "
+      "CPU-backend zero-copy donation hazard (PR 4 root cause)")
+def check_unguarded_donation(pf: PyFile) -> list[Finding]:
+    if pf.rel.replace("\\", "/").endswith(_HELPER_MODULE):
+        return []  # the helper itself is the sanctioned call site
+    out = []
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kw = next((k for k in node.keywords
+                   if k.arg in _DONATION_KWARGS), None)
+        if kw is None:
+            continue
+        if _terminal_name(node.func) == _SANCTIONED_CALLEE:
+            continue
+        out.append(Finding(
+            "unguarded-donation", pf.rel, node.lineno,
+            f"{kw.arg}= outside utils/donation.donated_jit — route the "
+            f"donation through the helper so the CPU zero-copy hazard is "
+            f"decided in one audited place"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rename-durability — PR 4 round 3: a rename that commits state must be
+# fsync-disciplined or a crash can surface a half-visible checkpoint
+
+
+_RENAME_ATTRS = ("rename", "replace", "renames")
+_DURABLE_MARKERS = ("fsync", "durable")
+
+
+def _is_rename_call(node: ast.Call) -> bool:
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _RENAME_ATTRS):
+        return False
+    if isinstance(f.value, ast.Name) and f.value.id == "os":
+        return True  # os.rename / os.replace / os.renames
+    # pathlib spelling: Path.replace(target) / Path.rename(target) take ONE
+    # positional arg — str.replace(old, new) takes two, which is what keeps
+    # this from flagging every string substitution in the package
+    return (f.attr in ("rename", "replace")
+            and len(node.args) == 1 and not node.keywords)
+
+
+@rule("rename-durability",
+      "os.rename/os.replace (or pathlib Path.rename/Path.replace) in a "
+      "function with no fsync (or *_durable helper) call — a crash can "
+      "publish the rename while losing the data it names (PR 4 round 3 "
+      "checkpoint discipline)")
+def check_rename_durability(pf: PyFile) -> list[Finding]:
+    funcs = None
+    out = []
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not _is_rename_call(node):
+            continue
+        if funcs is None:
+            funcs = _enclosing_functions(pf.tree)
+        enclosing = _innermost_function(funcs, node.lineno)
+        scope: ast.AST = enclosing if enclosing is not None else pf.tree
+        durable = any(
+            isinstance(n, ast.Call)
+            and (name := _terminal_name(n.func)) is not None
+            and any(mark in name.lower() for mark in _DURABLE_MARKERS)
+            for n in ast.walk(scope))
+        if not durable:
+            where = (f"function {enclosing.name}()" if enclosing is not None
+                     else "module scope")
+            out.append(Finding(
+                "rename-durability", pf.rel, node.lineno,
+                f"{ast.unparse(f)}() in {where} with no fsync in scope — "
+                f"fsync the data (and the directory) before the rename "
+                f"commits it, or pragma a non-durability rename"))
+    return out

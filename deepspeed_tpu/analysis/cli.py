@@ -1,0 +1,144 @@
+"""dstpu-lint CLI — the house exit-code contract:
+
+  0  clean (no findings, or none outside the baseline)
+  1  findings
+  2  usage error (bad path, unknown rule, unreadable baseline)
+
+Usage:
+
+  bin/dstpu_lint [PATH ...] [--rule ID] [--format text|json]
+                 [--baseline FILE] [--write-baseline FILE] [--list-rules]
+
+PATH defaults to the deepspeed_tpu package this file ships in. --rule may
+repeat (or take a comma list) to run a subset. --baseline FILE compares
+against a frozen finding set and fails only on NEW findings (incremental
+adoption); --write-baseline FILE freezes the current findings. The final
+tree keeps an EMPTY baseline — every finding is fixed or pragma'd
+(docs/analysis.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from . import core
+
+
+def _default_target() -> str:
+    # cli.py lives at <pkg>/analysis/cli.py -> lint <pkg>
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _print_text(result: core.LintResult, baselined: int,
+                elapsed: float, out) -> None:
+    for f in result.findings:
+        print(f"{f.location}: [{f.rule}] {f.message}", file=out)
+    n = len(result.findings)
+    verdict = "clean" if n == 0 else f"{n} finding(s)"
+    extras = [f"{result.files_checked} files",
+              f"{len(result.rules_run)} rules",
+              f"{len(result.suppressed)} suppressed",
+              f"{elapsed * 1000.0:.0f}ms"]
+    if baselined:
+        extras.append(f"{baselined} baselined")
+    print(f"dstpu-lint: {verdict} — {', '.join(extras)}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dstpu_lint",
+        description="deepspeed_tpu invariant checker (docs/analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="package dirs or .py files (default: the "
+                         "deepspeed_tpu package)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule id (repeatable / comma list)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="fail only on findings NOT in this frozen set")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="freeze the current findings and exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    # rules register on import (run_lint does this too; --list-rules needs
+    # the registry populated before any lint runs)
+    from . import checkers as _checkers  # noqa: F401
+    from . import drift as _drift  # noqa: F401
+
+    if args.list_rules:
+        width = max(len(r) for r in core.RULES)
+        for rid in sorted(core.RULES):
+            r = core.RULES[rid]
+            print(f"{rid:<{width}}  [{r.scope}] {r.doc}")
+        return 0
+
+    rule_ids = None
+    if args.rule:
+        rule_ids = [r.strip() for spec in args.rule
+                    for r in spec.split(",") if r.strip()]
+        unknown = [r for r in rule_ids if r not in core.RULES]
+        if unknown:
+            print(f"dstpu_lint: unknown rule id(s): {', '.join(unknown)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [_default_target()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"dstpu_lint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline = None
+    if args.baseline is not None:
+        try:
+            baseline = core.load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"dstpu_lint: unreadable baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    t0 = time.monotonic()
+    merged = core.LintResult()
+    for p in paths:
+        res = core.run_lint(p, rule_ids=rule_ids)
+        merged.findings.extend(res.findings)
+        merged.suppressed.extend(res.suppressed)
+        merged.files_checked += res.files_checked
+        merged.rules_run = sorted(set(merged.rules_run) | set(res.rules_run))
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline is not None:
+        core.write_baseline(args.write_baseline, merged.findings)
+        print(f"dstpu_lint: wrote {len(merged.findings)} finding(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    baselined = 0
+    if baseline is not None:
+        new = [f for f in merged.findings
+               if f.fingerprint() not in baseline]
+        baselined = len(merged.findings) - len(new)
+        merged.findings = new
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in merged.findings],
+            "suppressed": len(merged.suppressed),
+            "baselined": baselined,
+            "files_checked": merged.files_checked,
+            "rules_run": merged.rules_run,
+            "elapsed_s": round(elapsed, 4),
+        }, indent=1))
+    else:
+        _print_text(merged, baselined, elapsed, sys.stdout)
+    return 1 if merged.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

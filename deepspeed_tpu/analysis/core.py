@@ -1,0 +1,389 @@
+"""dstpu-lint core: rule registry, findings, pragmas, tree walking.
+
+Eight PRs of review hardening produced a body of load-bearing invariants
+that lived only in reviewers' heads and CHANGES.md prose — verdict clocks
+must be monotonic (PR 8's NTP-step incident), checkpoint renames must be
+fsync-disciplined (PR 4 round 3), donation must route through the
+CPU-backend-aware helper (PR 4 root cause), config keys and metric names
+must stay in sync with their docs. This package turns those rules into
+enforced static analysis: the reference DeepSpeed gates every commit on
+lint/format checks (PAPER.md §7 auxiliary tooling); ``bin/dstpu_lint`` is
+the project-native analogue, and ``tests/test_lint.py`` keeps the tree
+clean in tier-1.
+
+Design constraints, in order:
+
+  * stdlib-only (``ast`` + ``tokenize``) and importable WITHOUT jax — the
+    CLI must run on doc-editing machines and in CI log-scrapers, so no
+    module in ``analysis/`` may import from the parent package (whose
+    ``__init__`` pulls the runtime). ``bin/dstpu_lint`` loads this package
+    by file path for exactly that reason.
+  * whole-package runs finish in well under a second — rules are single
+    AST passes, no type inference, no imports of the linted code.
+  * every finding is suppressible INLINE with a written rationale:
+    ``# dstpu: allow[rule-id] -- rationale`` (markdown docs use
+    ``<!-- dstpu: allow[rule-id] -- rationale -->``). A pragma without a
+    rationale is itself a finding — the rationale is the point: it is the
+    review argument, kept next to the code it excuses.
+
+Rule taxonomy: ``file``-scope rules run once per parsed ``.py`` file;
+``project``-scope rules run once per tree and may cross-reference code
+against ``docs/`` (the drift checkers). Two pseudo-rules are always on and
+never suppressible: ``parse-error`` (a file the linter cannot read is a
+finding, not a skip) and ``pragma`` (malformed suppressions).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+# ---------------------------------------------------------------------------
+# findings
+
+PRAGMA_RULE = "pragma"
+PARSE_RULE = "parse-error"
+# rules that gate the suppression machinery itself: a pragma cannot excuse
+# a malformed pragma, and an unparseable file cannot carry a pragma at all
+_UNSUPPRESSIBLE = frozenset({PRAGMA_RULE, PARSE_RULE})
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative where possible (stable across checkouts)
+    line: int
+    message: str
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def fingerprint(self) -> str:
+        """Baseline identity. Line numbers are included on purpose: a
+        baseline is a short-lived adoption ratchet, not a permanent
+        suppression (that is what pragmas are for), so going stale on
+        unrelated edits is acceptable — it forces the debt to be looked at."""
+        return f"{self.rule}|{self.path}|{self.line}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    doc: str  # one-line: the invariant + its motivating incident
+    scope: str  # "file" | "project"
+    fn: Optional[Callable] = None
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(rid: str, doc: str, scope: str = "file"):
+    """Register a checker. ``file`` rules take a ``PyFile``; ``project``
+    rules take a ``Project`` (and run once per lint invocation)."""
+
+    def deco(fn):
+        if rid in RULES:
+            raise ValueError(f"duplicate rule id {rid!r}")
+        RULES[rid] = Rule(rid, doc, scope, fn)
+        return fn
+
+    return deco
+
+
+# the pseudo-rules exist in the registry so --rule validation, --list-rules
+# and docs/analysis.md can see them; their "checker" is the framework itself
+RULES[PARSE_RULE] = Rule(
+    PARSE_RULE, "a .py file the linter cannot parse is a finding, not a "
+    "silent skip (never suppressible)", "file")
+RULES[PRAGMA_RULE] = Rule(
+    PRAGMA_RULE, "suppression pragmas must name a known rule id and carry "
+    "a ' -- rationale' (never suppressible)", "file")
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+
+# matches inside a comment body (the literal syntax is spelled out in the
+# module docstring; not repeated here or this comment would match itself)
+_PRAGMA_RE = re.compile(
+    r"dstpu:\s*allow\[([^\]\s]*)\]\s*(?:--\s*(.*))?$")
+_MD_COMMENT_RE = re.compile(r"<!--(.*?)-->", re.DOTALL)
+
+
+@dataclass
+class _Pragma:
+    line: int  # line the comment sits on
+    rule_id: str
+    rationale: str
+    standalone: bool  # comment-only line: applies to the NEXT line too
+
+
+class Pragmas:
+    """Per-file suppression table + the findings the pragmas themselves
+    produce (missing rationale / unknown rule id)."""
+
+    def __init__(self, entries: list[_Pragma], rel: str):
+        self.findings: list[Finding] = []
+        self._allow: dict[int, set[str]] = {}
+        self.entries = entries
+        for p in entries:
+            if p.rule_id not in RULES:
+                self.findings.append(Finding(
+                    PRAGMA_RULE, rel, p.line,
+                    f"pragma names unknown rule id {p.rule_id!r} "
+                    f"(see --list-rules)"))
+                continue
+            if p.rule_id in _UNSUPPRESSIBLE:
+                self.findings.append(Finding(
+                    PRAGMA_RULE, rel, p.line,
+                    f"rule {p.rule_id!r} cannot be suppressed"))
+                continue
+            if not p.rationale.strip():
+                self.findings.append(Finding(
+                    PRAGMA_RULE, rel, p.line,
+                    f"pragma allow[{p.rule_id}] is missing its rationale "
+                    f"(write: # dstpu: allow[{p.rule_id}] -- why this is "
+                    f"safe)"))
+                continue
+            lines = [p.line, p.line + 1] if p.standalone else [p.line]
+            for ln in lines:
+                self._allow.setdefault(ln, set()).add(p.rule_id)
+
+    def suppresses(self, f: Finding) -> bool:
+        if f.rule in _UNSUPPRESSIBLE:
+            return False
+        return f.rule in self._allow.get(f.line, ())
+
+
+def _parse_py_pragmas(source: str, rel: str) -> Pragmas:
+    entries: list[_Pragma] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if m is None:
+                continue
+            standalone = tok.string.strip() == tok.line.strip()
+            entries.append(_Pragma(tok.start[0], m.group(1),
+                                   (m.group(2) or ""), standalone))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        pass  # the parse-error finding already covers unreadable files
+    return Pragmas(entries, rel)
+
+
+def _parse_md_pragmas(source: str, rel: str) -> Pragmas:
+    """Markdown suppression: an HTML comment ``<!-- dstpu: allow[id] --
+    rationale -->`` applies to its own line and the next (so a comment
+    line above a table row suppresses that row)."""
+    entries: list[_Pragma] = []
+    for i, line in enumerate(source.splitlines(), 1):
+        for cm in _MD_COMMENT_RE.finditer(line):
+            m = _PRAGMA_RE.search(cm.group(1).strip())
+            if m is None:
+                continue
+            standalone = line.strip().startswith("<!--")
+            entries.append(_Pragma(i, m.group(1), (m.group(2) or ""),
+                                   standalone))
+    return Pragmas(entries, rel)
+
+
+# ---------------------------------------------------------------------------
+# parsed inputs
+
+
+class PyFile:
+    """One parsed source file handed to file-scope rules."""
+
+    def __init__(self, path: str, rel: str, source: str,
+                 tree: Optional[ast.AST]):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree  # None when the file failed to parse
+
+
+class Project:
+    """The lint target as a whole: the package root plus the repo around it
+    (project-scope rules cross-reference ``docs/``)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        # the package dir's parent is the repo (deepspeed_tpu/ -> repo/);
+        # fixture trees in tests mirror the same shape
+        self.repo = os.path.dirname(self.root)
+        self.files: list[PyFile] = []
+
+    def doc_path(self, name: str) -> str:
+        return os.path.join(self.repo, "docs", name)
+
+    def rel(self, path: str) -> str:
+        try:
+            return os.path.relpath(path, self.repo)
+        except ValueError:  # different drive (windows)
+            return path
+
+
+# ---------------------------------------------------------------------------
+# running
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    files_checked: int = 0
+    rules_run: list[str] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def _iter_py_files(target: str) -> Iterable[str]:
+    if os.path.isfile(target):
+        yield target
+        return
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__" and not d.startswith("."))
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def run_lint(target: str, rule_ids: Optional[list[str]] = None) -> LintResult:
+    """Lint ``target`` (a package directory, or one .py file) with the
+    selected rules (default: all registered). Suppressed findings are kept
+    separately so reports can say how much is pragma'd."""
+    # checkers register on import; keep this lazy so `core` alone stays
+    # importable by tooling that only wants Finding/baseline helpers
+    from . import checkers as _checkers  # noqa: F401
+    from . import drift as _drift  # noqa: F401
+
+    if rule_ids is None:
+        selected = dict(RULES)
+    else:
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {', '.join(unknown)}")
+        selected = {r: RULES[r] for r in rule_ids}
+        # the pseudo-rules ride along: a selected-rule pragma still needs
+        # its contract enforced, and an unparseable file is never clean
+        selected.setdefault(PRAGMA_RULE, RULES[PRAGMA_RULE])
+        selected.setdefault(PARSE_RULE, RULES[PARSE_RULE])
+
+    target = os.path.abspath(target)
+    root = target if os.path.isdir(target) else os.path.dirname(target)
+    project = Project(root)
+
+    raw: list[Finding] = []
+    pragma_cache: dict[str, Pragmas] = {}
+
+    for path in _iter_py_files(target):
+        rel = project.rel(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            raw.append(Finding(PARSE_RULE, rel, 1, f"unreadable: {e}"))
+            continue
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            raw.append(Finding(PARSE_RULE, rel, e.lineno or 1,
+                               f"syntax error: {e.msg}"))
+            tree = None
+        pf = PyFile(path, rel, source, tree)
+        project.files.append(pf)
+        pragmas = _parse_py_pragmas(source, rel)
+        pragma_cache[rel] = pragmas
+        raw.extend(pragmas.findings)
+        if tree is None:
+            continue
+        for r in selected.values():
+            if r.scope == "file" and r.fn is not None:
+                raw.extend(r.fn(pf))
+
+    for r in selected.values():
+        if r.scope == "project" and r.fn is not None:
+            raw.extend(r.fn(project))
+
+    # markdown pragmas are validated EAGERLY for every doc next to the
+    # package, not just docs a drift finding happens to anchor in — a
+    # rationale-less doc pragma on a clean tree must be a finding NOW, not
+    # spring one at whoever causes the first drift there later
+    docs_dir = os.path.join(project.repo, "docs")
+    if os.path.isdir(target) and os.path.isdir(docs_dir):
+        for name in sorted(os.listdir(docs_dir)):
+            if not name.endswith(".md"):
+                continue
+            rel = project.rel(os.path.join(docs_dir, name))
+            if rel in pragma_cache:
+                continue
+            try:
+                with open(os.path.join(docs_dir, name),
+                          encoding="utf-8") as fh:
+                    pragmas = _parse_md_pragmas(fh.read(), rel)
+            except OSError:
+                continue
+            pragma_cache[rel] = pragmas
+            raw.extend(pragmas.findings)
+
+    result = LintResult(files_checked=len(project.files),
+                        rules_run=sorted(selected))
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        pragmas = pragma_cache.get(f.path)
+        if pragmas is None and f.path.endswith(".md"):
+            # drift findings anchor in docs; parse the doc's pragmas lazily
+            full = os.path.join(project.repo, f.path)
+            try:
+                with open(full, encoding="utf-8") as fh:
+                    pragmas = _parse_md_pragmas(fh.read(), f.path)
+            except OSError:
+                pragmas = Pragmas([], f.path)
+            pragma_cache[f.path] = pragmas
+            result.findings.extend(pragmas.findings)
+        if pragmas is not None and pragmas.suppresses(f):
+            result.suppressed.append(f)
+        else:
+            result.findings.append(f)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# baselines (incremental adoption: freeze today's findings, fail on new)
+
+
+def load_baseline(path: str) -> set[str]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"{path}: not a dstpu-lint baseline "
+                         "(expected {'version': 1, 'findings': [...]})")
+    return set(data["findings"])
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    data = {"version": 1,
+            "findings": sorted(f.fingerprint() for f in findings)}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")

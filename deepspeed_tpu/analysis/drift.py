@@ -1,0 +1,294 @@
+"""Project-scope drift checkers: code vs docs, both directions.
+
+docs/config.md and docs/observability.md are contracts, not commentary —
+operators key JSON configs and dashboards off them. These two rules make
+the tables machine-checked so an added config field or metric name that
+skips its doc (or a doc row whose code was deleted) fails tier-1 instead
+of drifting silently.
+
+Both checkers anchor code-side findings at the offending line of the
+source file and doc-side findings at the offending line of the markdown
+table; markdown rows are suppressed with an HTML-comment pragma
+(``<!-- dstpu: allow[rule-id] -- rationale -->``) on the row or the line
+above it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+from .core import Finding, Project, rule
+
+# ---------------------------------------------------------------------------
+# shared markdown helpers
+
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _table_rows(doc: str):
+    """Yield (lineno, [cell, ...]) for markdown table body rows (header
+    and |---| separator rows skipped)."""
+    for i, line in enumerate(doc.splitlines(), 1):
+        s = line.strip()
+        if not (s.startswith("|") and s.endswith("|") and s.count("|") >= 3):
+            continue
+        cells = [c.strip() for c in s[1:-1].split("|")]
+        if all(set(c) <= set("-: ") for c in cells):
+            continue  # |---|---| separator
+        yield i, cells
+
+
+# ---------------------------------------------------------------------------
+# config-doc-drift
+
+
+_CONFIG_SOURCE = os.path.join("runtime", "config.py")
+_CONFIG_DOC = "config.md"
+# fields that are implementation plumbing, not user-facing JSON keys
+_PRIVATE_FIELDS = {"raw"}
+# a doc table cell must look like one plain (possibly dotted) config key,
+# optionally annotated `key: value`, to be checked in the doc→code direction
+_DOC_KEY_RE = re.compile(r"[a-z_][a-z0-9_]*(?:\.[a-z_][a-z0-9_]*)*(?::.*)?$")
+
+
+def _dataclass_fields(tree: ast.AST):
+    """(class_name, field_name, lineno) for every @dataclass field."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        is_dc = any(
+            (isinstance(d, ast.Name) and d.id == "dataclass")
+            or (isinstance(d, ast.Attribute) and d.attr == "dataclass")
+            or (isinstance(d, ast.Call)
+                and isinstance(d.func, (ast.Name, ast.Attribute))
+                and (getattr(d.func, "id", None) == "dataclass"
+                     or getattr(d.func, "attr", None) == "dataclass"))
+            for d in node.decorator_list)
+        if not is_dc:
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                name = stmt.target.id
+                if name.startswith("_") or name in _PRIVATE_FIELDS:
+                    continue
+                yield node.name, name, stmt.lineno
+
+
+@rule("config-doc-drift",
+      "runtime/config.py dataclass fields and the docs/config.md key "
+      "tables must agree both ways: every field documented, every "
+      "table key backed by a field", scope="project")
+def check_config_doc(project: Project) -> list[Finding]:
+    cfg_path = os.path.join(project.root, _CONFIG_SOURCE)
+    doc_path = project.doc_path(_CONFIG_DOC)
+    cfg_src, doc = _read(cfg_path), _read(doc_path)
+    if cfg_src is None or doc is None:
+        return []  # partial target (single-file lint / no docs tree)
+    try:
+        tree = ast.parse(cfg_src)
+    except SyntaxError:
+        return []  # parse-error finding already raised by the core walk
+    cfg_rel = project.rel(cfg_path)
+    doc_rel = project.rel(doc_path)
+
+    fields = list(_dataclass_fields(tree))
+    field_names = {f for _, f, _ in fields}
+
+    # code -> doc: every field must be MENTIONED in config.md. Tokenize the
+    # whole doc (not just backtick spans): fenced code blocks and multi-line
+    # inline spans defeat whole-document span pairing, and an example JSON
+    # block legitimately documents its keys. Identifier tokenization still
+    # rejects near-misses (`reduce-scatter` does not cover reduce_scatter).
+    doc_tokens = set(_IDENT_RE.findall(doc))
+    out = []
+    for cls, name, lineno in fields:
+        if name not in doc_tokens:
+            out.append(Finding(
+                "config-doc-drift", cfg_rel, lineno,
+                f"config field {cls}.{name} is not documented in "
+                f"docs/config.md — add it to the key tables (they are "
+                f"machine-checked)"))
+
+    # doc -> code: every single-key table cell must be a real field
+    for lineno, cells in _table_rows(doc):
+        first = cells[0] if cells else ""
+        spans = _BACKTICK_RE.findall(first)
+        # only rows whose first cell is exactly ONE backticked key are
+        # checkable; prose cells and multi-key cells are skipped
+        if len(spans) != 1 or first != f"`{spans[0]}`":
+            continue
+        key = spans[0]
+        if not _DOC_KEY_RE.fullmatch(key):
+            continue
+        leaf = key.split(":", 1)[0].strip().split(".")[-1]
+        if leaf not in field_names:
+            out.append(Finding(
+                "config-doc-drift", doc_rel, lineno,
+                f"docs/config.md documents key `{key}` but no config "
+                f"dataclass has a field {leaf!r} — the code moved on, or "
+                f"the key is misspelled"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# metric-doc-drift
+
+
+_METRIC_DOC = "observability.md"
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+_KIND_WORDS = {"counter", "gauge", "histogram"}
+_PLACEHOLDER_RE = re.compile(r"<[^>]*>|\[[^\]]*\]|\{[^}]*\}")
+
+
+def _metric_pattern(name: str) -> re.Pattern:
+    """Catalog name -> regex: `<op>`/`[N]`/`{x}` spans match anything."""
+    out = []
+    pos = 0
+    for m in _PLACEHOLDER_RE.finditer(name):
+        out.append(re.escape(name[pos:m.start()]))
+        out.append(r".+")
+        pos = m.end()
+    out.append(re.escape(name[pos:]))
+    return re.compile("".join(out) + r"\Z")
+
+
+def _literal_head(name: str) -> str:
+    m = _PLACEHOLDER_RE.search(name)
+    return name[:m.start()] if m else name
+
+
+def _metric_arg(node: ast.Call):
+    """First positional arg -> ('literal', name) | ('affix', (head, tail))
+    | None. Dynamic names keep their constant head and/or tail — enough to
+    pair ``f"rpc/{name}"`` with the ``rpc/*`` catalog rows and
+    ``f"{gauge}/mfu"`` with ``train/mfu``/``serving/mfu``."""
+    if not node.args:
+        return None
+    a = node.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return ("literal", a.value)
+    if isinstance(a, ast.JoinedStr):
+        parts = a.values
+        head = tail = ""
+        if parts and isinstance(parts[0], ast.Constant) and isinstance(
+                parts[0].value, str):
+            head = parts[0].value
+        if (len(parts) > 1 and isinstance(parts[-1], ast.Constant)
+                and isinstance(parts[-1].value, str)):
+            tail = parts[-1].value
+        return ("affix", (head, tail)) if (head or tail) else None
+    if (isinstance(a, ast.BinOp) and isinstance(a.op, ast.Add)
+            and isinstance(a.left, ast.Constant)
+            and isinstance(a.left.value, str)):
+        return ("affix", (a.left.value, ""))
+    return None
+
+
+def _affix_covers(name: str, head: str, tail: str) -> bool:
+    """Could a dynamic name with this constant head/tail produce ``name``
+    (a catalog entry with placeholders stripped to its literal head)?"""
+    lit = _literal_head(name)
+    if head and not (lit.startswith(head) or head.startswith(lit)):
+        return False
+    if tail and not name.endswith(tail):
+        return False
+    return bool(head or tail)
+
+
+@rule("metric-doc-drift",
+      "string-literal metric names passed to registry "
+      "counter/gauge/histogram constructors and the docs/observability.md "
+      "catalog tables must agree both ways", scope="project")
+def check_metric_doc(project: Project) -> list[Finding]:
+    doc_path = project.doc_path(_METRIC_DOC)
+    doc = _read(doc_path)
+    if doc is None or not project.files:
+        return []
+    doc_rel = project.rel(doc_path)
+
+    # -- doc side: catalog rows are table rows whose kind cell names a
+    # metric kind; a first cell may carry several backticked names
+    catalog: list[tuple[str, int]] = []  # (name, doc lineno)
+    for lineno, cells in _table_rows(doc):
+        if len(cells) < 2:
+            continue
+        kind_words = set(_IDENT_RE.findall(cells[1].lower()))
+        if not (kind_words & _KIND_WORDS):
+            continue
+        for span in _BACKTICK_RE.findall(cells[0]):
+            if "/" in span:
+                catalog.append((span, lineno))
+    patterns = [(name, _metric_pattern(name)) for name, _ in catalog]
+
+    # -- code side: constructor call sites + every string constant that
+    # looks like a metric name (covers names passed through variables,
+    # e.g. ledger.bind(..., gauge="train/mfu"))
+    literals: list[tuple[str, str, int]] = []  # (name, rel, lineno)
+    affixes: list[tuple[str, str, str, int]] = []  # (head, tail, rel, line)
+    all_consts: set[str] = set()
+    for pf in project.files:
+        if pf.tree is None:
+            continue
+        if "/analysis/" in "/" + pf.rel.replace("\\", "/"):
+            continue  # the linter's own fixtures/doc examples
+        for node in ast.walk(pf.tree):
+            if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                    and "/" in node.value):
+                all_consts.add(node.value)
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS):
+                continue
+            got = _metric_arg(node)
+            if got is None:
+                continue
+            kind, value = got
+            if kind == "literal":
+                literals.append((value, pf.rel, node.lineno))
+            else:
+                affixes.append((value[0], value[1], pf.rel, node.lineno))
+
+    out = []
+    # code -> doc: every literal metric name must match a catalog pattern;
+    # every dynamic-name prefix must be covered by some catalog entry
+    for name, rel, lineno in literals:
+        if not any(p.match(name) for _, p in patterns):
+            out.append(Finding(
+                "metric-doc-drift", rel, lineno,
+                f"metric {name!r} is not in the docs/observability.md "
+                f"catalog — add a table row (the catalog is "
+                f"machine-checked)"))
+    for head, tail, rel, lineno in affixes:
+        if not any(_affix_covers(n, head, tail) for n, _ in catalog):
+            out.append(Finding(
+                "metric-doc-drift", rel, lineno,
+                f"dynamically-named metric ({head!r}...{tail!r}) matches "
+                f"no docs/observability.md catalog entry"))
+
+    # doc -> code: every catalog entry needs a plausible code source
+    lit_names = {n for n, _, _ in literals}
+    for name, lineno in catalog:
+        pat = _metric_pattern(name)
+        ok = (any(pat.match(n) for n in lit_names)
+              or any(_affix_covers(name, h, t) for h, t, _, _ in affixes)
+              or name in all_consts)
+        if not ok:
+            out.append(Finding(
+                "metric-doc-drift", doc_rel, lineno,
+                f"docs/observability.md catalogs metric `{name}` but no "
+                f"code path constructs it — stale row, or the name "
+                f"drifted"))
+    return out

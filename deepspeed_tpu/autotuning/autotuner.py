@@ -207,6 +207,7 @@ class Autotuner:
         try:
             est = self._estimate_mem_gb(overrides)
             hbm = self._device_mem_gb()
+        # dstpu: allow[broad-except] -- the memory estimate only orders the trial queue: any estimator failure must degrade to 'unranked', never abort the tuning sweep it is trying to speed up
         except Exception:  # noqa: BLE001 — estimation must never kill tuning
             est = hbm = None
         if est is not None and est > hbm:
@@ -244,6 +245,7 @@ class Autotuner:
             trial.step_ms = dt * 1e3
             trial.tokens_per_sec = tokens / dt
             trial.status = "ok"
+        # dstpu: allow[broad-except] -- a tuning trial exists to discover HOW a candidate config fails (OOM, compile error, shape mismatch, ...); every failure kind is the trial's RESULT, recorded with its type name
         except Exception as e:  # noqa: BLE001 — a failing candidate is data
             trial.status = "failed"
             trial.error = f"{type(e).__name__}: {str(e)[:300]}"

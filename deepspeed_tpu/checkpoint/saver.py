@@ -272,6 +272,7 @@ def save_checkpoint(
                     tmp = os.path.join(stage_dir, fname + ".tmp")
                     manifest["checksums"][fname] = _save_array_durable(tmp, arr)
                     os.replace(tmp, os.path.join(stage_dir, fname))
+        # dstpu: allow[broad-except] -- the async writer runs on a daemon thread: EVERY failure kind (OSError, np.save ValueError, MemoryError) must be captured and re-raised on handle.wait(); a narrowed clause would let an unexpected type vanish with the thread and read as a successful save
         except Exception as e:  # surfaced on handle.wait()
             errors.append(e)
 

@@ -21,6 +21,7 @@ def _nbytes(tensor) -> int:
     try:
         size = int(np.prod(tensor.shape))
         return size * tensor.dtype.itemsize
+    # dstpu: allow[broad-except] -- duck-typed byte probe over arbitrary "tensor" objects (tracers, shape structs, user types); 0 bytes is the documented fallback and comm logging must never fail a collective
     except Exception:
         return 0
 

@@ -109,6 +109,7 @@ from ..runtime.config import (ChunkedPrefillConfig, FaultInjectionConfig,
                               LedgerConfig, PrefixCacheConfig,
                               RequestTraceConfig)
 from ..telemetry import RequestTracer, Telemetry, hbm_snapshot, tree_bytes
+from ..utils.donation import donated_jit
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
 from .prefix_cache import PrefixIndex
@@ -305,8 +306,11 @@ class SlotWorker:
             nxt = sample_logits_vector(logits[:, 0], rng, temp, top_k, top_p)
             return cache, jnp.where(active, nxt, 0), bad
 
-        return jax.jit(decode, donate_argnums=(1,),
-                       out_shardings=(self._cache_shardings, None, None))
+        # all serving programs donate the slot KV cache / prefix pool —
+        # XLA-created device buffers, never CPU zero-copy host memory, so
+        # donation stays on every backend (utils/donation.py is the gate)
+        return donated_jit(decode, donate_argnums=(1,),
+                           out_shardings=(self._cache_shardings, None, None))
 
     def _build_prefill(self, bucket: int):
         cfg = self.cfg
@@ -327,8 +331,8 @@ class SlotWorker:
             }
             return cache, tok, bad
 
-        return jax.jit(prefill, donate_argnums=(1,),
-                       out_shardings=(self._cache_shardings, None, None))
+        return donated_jit(prefill, donate_argnums=(1,),
+                           out_shardings=(self._cache_shardings, None, None))
 
     def _build_chunk(self, width: int):
         cfg = self.cfg
@@ -363,8 +367,8 @@ class SlotWorker:
             new_kv = tfm.slice_cache_slot(local, 0, width, start=start)
             return tfm.update_cache_slot(cache, new_kv, slot, start=start), tok, bad
 
-        return jax.jit(chunk, donate_argnums=(1,),
-                       out_shardings=(self._cache_shardings, None, None))
+        return donated_jit(chunk, donate_argnums=(1,),
+                           out_shardings=(self._cache_shardings, None, None))
 
     def _build_fetch(self):
         pmax = self.pmax
@@ -377,8 +381,8 @@ class SlotWorker:
             return tfm.update_cache_slot(
                 cache, tfm.slice_cache_slot(pool, pool_slot, pmax), slot)
 
-        return jax.jit(fetch, donate_argnums=(0,),
-                       out_shardings=self._cache_shardings)
+        return donated_jit(fetch, donate_argnums=(0,),
+                           out_shardings=self._cache_shardings)
 
     def _build_store(self):
         pmax = self.pmax
@@ -387,8 +391,8 @@ class SlotWorker:
             return tfm.update_cache_slot(
                 pool, tfm.slice_cache_slot(cache, slot, pmax), pool_slot)
 
-        return jax.jit(store, donate_argnums=(0,),
-                       out_shardings=self._pool_shardings)
+        return donated_jit(store, donate_argnums=(0,),
+                           out_shardings=self._pool_shardings)
 
     def _chunk_prog(self, width: int):
         if width not in self._chunk_progs:
@@ -540,8 +544,8 @@ class SlotWorker:
 
             wd = self.telemetry.watchdog
             self._poison = wd.watch(
-                jax.jit(fill, donate_argnums=(0,),
-                        out_shardings=self._cache_shardings),
+                donated_jit(fill, donate_argnums=(0,),
+                            out_shardings=self._cache_shardings),
                 wd.unique_name("serving/fill_slot"), stable=True)
         self._cache = self._poison(
             self._cache, jnp.int32(slot),

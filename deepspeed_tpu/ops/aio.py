@@ -38,6 +38,7 @@ def _build_library() -> Optional[ctypes.CDLL]:
         so = os.path.join(out_dir, "libdstpu_aio.so")
         try:
             if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+                # dstpu: allow[blocking-under-lock] -- serializing the one-time native build IS this lock's job: concurrent g++ invocations would race on the .so; waiters need the build done before they can proceed anyway
                 subprocess.run(
                     ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so, src,
                      "-lpthread"],
@@ -103,6 +104,7 @@ class AsyncIOHandle:
     def __del__(self):  # pragma: no cover - gc timing
         try:
             self.close()
+        # dstpu: allow[broad-except] -- __del__ runs at unpredictable gc/interpreter-shutdown points where raising is undefined behavior; close() failures here are unreportable by construction
         except Exception:
             pass
 

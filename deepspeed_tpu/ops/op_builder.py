@@ -44,6 +44,7 @@ class OpBuilder:
             return False, f"disabled via DS_BUILD_{self.NAME.upper()}=0"
         try:
             return self._probe()
+        # dstpu: allow[broad-except] -- compatibility probes run arbitrary environment checks (imports, subprocess, ctypes) whose failure TYPES are the incompatibility being probed; the (False, reason) return is the typed answer
         except Exception as e:  # noqa: BLE001 — a probe must never raise
             return False, f"{type(e).__name__}: {str(e)[:120]}"
 

@@ -149,6 +149,7 @@ class PipelineModule:
             try:
                 shapes = jax.eval_shape(layer.init, jax.random.PRNGKey(0))
                 return float(sum(int(jnp.prod(jnp.asarray(s.shape))) for s in jax.tree.leaves(shapes))) or 1.0
+            # dstpu: allow[broad-except] -- partition weighting is a load-balance heuristic: eval_shape over arbitrary user layer inits can raise anything, and degrading to uniform weights only costs balance, never correctness
             except Exception:
                 return 1.0
         raise ValueError(method)

@@ -196,6 +196,7 @@ class FlopsProfiler:
         latency = None
         try:
             xla_cost = aot_cost(jitted, args)
+        # dstpu: allow[broad-except] -- the XLA cost model is advisory: backends raise version-specific types and the jaxpr FLOP walk below is the fallback answer
         except Exception:  # noqa: BLE001 — profiling must not raise
             xla_cost = {}
         xla_flops = xla_cost.get("flops")

@@ -37,6 +37,7 @@ from ..comm.mesh import MeshConfig, build_mesh, data_parallel_size
 from ..parallel import sharding as shd
 from ..ops.optimizers import get_optimizer
 from ..utils import jax_compat
+from ..utils.donation import donated_jit
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .config import DeepSpeedConfig
@@ -1407,34 +1408,14 @@ class DeepSpeedEngine:
         propagate the same shardings elementwise."""
         kwargs = dict(
             in_shardings=(self._state_shardings, NamedSharding(self.mesh, batch_spec)),
-            donate_argnums=(0,),
         )
-        if self.config.debug.nan_check:
-            # jax_debug_nans re-executes the failing op to localise it — the
-            # donated inputs must stay alive for that
-            kwargs.pop("donate_argnums")
+        # jax_debug_nans re-executes the failing op to localise it — the
+        # donated inputs must stay alive for that
+        donate = () if self.config.debug.nan_check else (0,)
         mixes_spaces = (
             getattr(getattr(self.model, "config", None), "remat_offload", False)
             or self.offload_param_enabled
         )
-        if (jax.default_backend() == "cpu"
-                and (mixes_spaces or self.offload_optimizer_enabled)
-                and "donate_argnums" in kwargs):
-            # XLA:CPU zero-copy/donation hazard (the test_offload transient-
-            # NaN flake, root-caused in PR 4): programs carrying host memory
-            # spaces (compute_on('device_host') regions / offload
-            # placements) can hand back output buffers whose backing memory
-            # is not XLA-owned for the array's full lifetime on the CPU
-            # backend; DONATING those buffers into the next step turns heap
-            # churn into silent param corruption (1-2 garbage steps, 2/8
-            # suite runs — 0/8 with donation off; _verify_state_shardings'
-            # per-step device_put re-placement was accidentally laundering
-            # most leaves, which is why the flake was intermittent). The CPU
-            # backend is the 8-virtual-device TEST harness: forgoing
-            # donation there costs only transient test memory. Accelerator
-            # backends copy host->HBM (no zero-copy aliasing) and keep
-            # donation — on TPU it is what makes resident state fit.
-            kwargs.pop("donate_argnums")
         self._mixes_spaces = mixes_spaces
         self._check_output_shardings = mixes_spaces
         self._last_batch_shapes = None
@@ -1446,7 +1427,14 @@ class DeepSpeedEngine:
             # first clean pass) so a host-memory leaf silently landing back
             # in device memory can't regress the offload savings unnoticed
             self._check_output_shardings = True
-        return self._watch_step(jax.jit(train_step, **kwargs))
+        # donation is decided by the sanctioned gate: host-memory-space
+        # programs (offload / host remat) must not donate on the CPU backend
+        # (the test_offload transient-NaN flake root-caused in PR 4 — full
+        # story in utils/donation.py)
+        return self._watch_step(donated_jit(
+            train_step, donate_argnums=donate,
+            mixes_host_memory=mixes_spaces or self.offload_optimizer_enabled,
+            **kwargs))
 
     def _watch_step(self, jitted):
         """Register a built train-step program with the recompile watchdog.
@@ -1796,6 +1784,7 @@ class DeepSpeedEngine:
             if jax.process_index() == 0:
                 prof.print_model_profile(
                     res, detailed=self.config.flops_profiler.detailed)
+        # dstpu: allow[broad-except] -- the flops profiler is advisory: it walks jaxprs and XLA cost models that raise version-specific types, and a profiling failure must never kill the training step it was asked to describe
         except Exception as e:  # noqa: BLE001 — profiling must not kill training
             logger.warning(f"flops profiler failed: {e}")
 
@@ -1883,8 +1872,12 @@ class DeepSpeedEngine:
                 out["layers"] = layers
                 return out
 
-            fn = self._quant_fns[bits] = jax.jit(
-                quantize_params, out_shardings=self._state_shardings["params"], donate_argnums=0
+            fn = self._quant_fns[bits] = donated_jit(
+                quantize_params, out_shardings=self._state_shardings["params"],
+                donate_argnums=0,
+                # the donated operand is the param tree itself — host memory
+                # space when the param tier is offloaded
+                mixes_host_memory=self.offload_param_enabled,
             )
         self.state["params"] = fn(self.state["params"])
 
@@ -2040,7 +2033,13 @@ class DeepSpeedEngine:
                 **extras,
             }, ~finite
 
-        self._apply_fn = jax.jit(apply_of, donate_argnums=(0, 1), static_argnums=(2,))
+        # donates (state, grads): with an offloaded tier those trees carry
+        # host-memory-space leaves, so the gate must know (the 3-call loop
+        # rejects offload_param, but offload_optimizer reaches here)
+        self._apply_fn = donated_jit(
+            apply_of, donate_argnums=(0, 1), static_argnums=(2,),
+            mixes_host_memory=(self.offload_optimizer_enabled
+                               or self.offload_param_enabled))
 
     def backward(self, loss=None):
         """Accumulate gradients for the batch last passed to forward()."""

@@ -128,6 +128,7 @@ def _arg_spec(leaf):
         try:
             return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
                                         sharding=sharding)
+        # dstpu: allow[broad-except] -- spec capture is observability-only: ShapeDtypeStruct rejects exotic shardings with version-specific types, and the unsharded struct is the documented degraded answer
         except Exception:  # exotic sharding the struct can't carry
             return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
     return leaf  # python scalars etc. lower as they were called
@@ -194,6 +195,7 @@ class ProgramLedger:
         if self._peaks is None:
             try:
                 self._peaks = platform_peaks()
+            # dstpu: allow[broad-except] -- platform probing must degrade to the 'unknown' peak row (unrated, never wrong) in jax-less/device-less processes, whatever the backend raises
             except Exception:  # no jax/devices in this process
                 self._peaks = {"platform": "unknown", "device_kind": "",
                                **PEAKS["unknown"]}
@@ -230,6 +232,7 @@ class ProgramLedger:
             else:
                 row["compiles"] += 1
                 row["compile_s"] += float(compile_s)
+        # dstpu: allow[broad-except] -- ledger capture rides the compile-event path of a LIVE dispatch: any failure kind must be logged and dropped, or observability could fail the program it observes
         except Exception as e:  # noqa: BLE001 — never break the dispatch
             logger.debug(f"program ledger capture failed for {name!r}: {e}")
 
@@ -260,6 +263,7 @@ class ProgramLedger:
             row = self.entries[name]
             try:
                 cost = aot_cost(fn, specs, kw_specs)
+            # dstpu: allow[broad-except] -- lazy AOT cost resolution calls backend introspection that raises version/backend-specific types; the row records the error string and the snapshot stays serveable
             except Exception as e:  # noqa: BLE001 — introspection only
                 row["error"] = f"{type(e).__name__}: {e}"
                 logger.debug(f"program ledger resolve failed for {name!r}: {e}")

@@ -1,0 +1,56 @@
+"""The sanctioned donation gate — every ``donate_argnums`` in this package
+routes through here (enforced by dstpu-lint's ``unguarded-donation`` rule;
+docs/analysis.md).
+
+Why a gate exists (PR 4 root cause): on the XLA:CPU backend,
+``make_array_from_callback`` / ``device_put`` / host-memory-space program
+outputs can ZERO-COPY numpy-backed buffers into jax arrays, and that
+backing memory is not reliably pinned for the array's lifetime. DONATING
+such a buffer into the next step turns ordinary heap churn into silent
+use-after-free — the param_offload transient-NaN flake reproduced 11/11
+with heap churn between load and step, 0/11 with donation off. Accelerator
+backends copy host→HBM (no zero-copy aliasing), so donation stays on
+there — on TPU it is what makes resident state fit.
+
+The hazard is a property of WHERE the donated operands came from, not of
+donation itself:
+
+  * programs that mix memory spaces (host-offloaded activation
+    checkpoints, param/optimizer offload) hand back host-memory outputs on
+    CPU — pass ``mixes_host_memory=True`` and the gate drops donation on
+    the CPU backend only;
+  * programs whose donated operands are always XLA-created device buffers
+    (the serving slot KV cache, the prefix pool) keep donation on every
+    backend — the default.
+
+Each call site answers that one question once, here, instead of every
+reviewer re-deriving PR 4 on every diff.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def cpu_donation_hazard(*, mixes_host_memory: bool) -> bool:
+    """True when donation must be dropped: the CPU backend is live AND the
+    program carries host memory spaces whose output buffers may be
+    numpy-zero-copy (the PR 4 use-after-free)."""
+    return bool(mixes_host_memory) and jax.default_backend() == "cpu"
+
+
+def donated_jit(fun, *, donate_argnums=(), mixes_host_memory: bool = False,
+                **jit_kwargs):
+    """``jax.jit`` with audited donation. ``donate_argnums=()`` compiles
+    without donation (callers gate e.g. ``debug.nan_check`` by passing an
+    empty tuple — jax_debug_nans re-executes the failing op, so the inputs
+    must stay alive). ``mixes_host_memory=True`` declares that the donated
+    operands/outputs may live in host memory space: donation is then
+    dropped on the CPU backend (see module docstring), kept elsewhere."""
+    if donate_argnums not in ((), None) and not cpu_donation_hazard(
+            mixes_host_memory=mixes_host_memory):
+        jit_kwargs["donate_argnums"] = donate_argnums
+    return jax.jit(fun, **jit_kwargs)
+
+
+__all__ = ["cpu_donation_hazard", "donated_jit"]

@@ -84,6 +84,7 @@ def compiled_cost_analysis(compiled) -> dict:
     backend has no cost model."""
     try:
         ca = compiled.cost_analysis()
+    # dstpu: allow[broad-except] -- version shim: backends without a cost model raise arbitrary types across jax releases; {} is the documented degraded answer every caller handles
     except Exception:
         return {}
     if isinstance(ca, (list, tuple)):
@@ -97,6 +98,7 @@ def compiled_memory_stats(compiled) -> dict:
     HBM footprint of one executable. {} when the backend can't say."""
     try:
         ma = compiled.memory_analysis()
+    # dstpu: allow[broad-except] -- version shim: same contract as compiled_cost_analysis above — backend introspection may raise anything, {} is the degraded answer
     except Exception:
         return {}
     if ma is None:

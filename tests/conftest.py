@@ -95,6 +95,20 @@ def pytest_sessionfinish(session, exitstatus):
     except Exception as e:  # noqa: BLE001 — advisory only, never fails a run
         print(f"\n[conftest] tier-1 budget check skipped: {e}")
 
+    # One-line lint verdict next to the budget verdict: the clean gate in
+    # test_lint.py already FAILS the suite on findings — this line exists
+    # so a full-run log shows the invariant-checker state at a glance even
+    # when someone runs with `-k 'not lint'`. Warn-only by construction.
+    lint = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "bin", "dstpu_lint")
+    try:
+        proc = subprocess.run([sys.executable, lint], capture_output=True,
+                              text=True, timeout=60)
+        verdict = (proc.stdout.strip().splitlines() or ["no output"])[-1]
+        print(f"-- {verdict} (bin/dstpu_lint, warn-only) --")
+    except Exception as e:  # noqa: BLE001 — advisory only, never fails a run
+        print(f"[conftest] dstpu-lint verdict skipped: {e}")
+
 
 @pytest.fixture(scope="session")
 def tiny_serving_engine():

@@ -1,0 +1,569 @@
+"""dstpu-lint: per-checker fixtures, pragma contract, drift seeding, the
+whole-tree clean gate, and the CLI exit-code contract (docs/analysis.md).
+
+Host-only: no compiled programs, no device work — the whole module costs
+seconds of tier-1 budget. Fixture trees mirror the repo shape
+(``pkg/<subdir>/x.py`` + sibling ``docs/``) so the project-scope drift
+rules resolve their cross-references the same way they do on the real
+tree."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.analysis import RULES, run_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "deepspeed_tpu")
+LINT = os.path.join(REPO, "bin", "dstpu_lint")
+
+
+def make_tree(tmp_path, files, docs=None):
+    """Build pkg/<rel>=src (+ optional sibling docs/) and return pkg dir."""
+    pkg = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    for rel, src in (docs or {}).items():
+        d = tmp_path / "docs" / rel
+        d.parent.mkdir(parents=True, exist_ok=True)
+        d.write_text(textwrap.dedent(src))
+    return str(pkg)
+
+
+def findings_for(res, rule):
+    return [f for f in res.findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# registry / framework
+
+
+def test_registry_has_the_shipped_rules():
+    expected = {"wall-clock-verdict", "broad-except", "blocking-under-lock",
+                "unguarded-donation", "rename-durability",
+                "config-doc-drift", "metric-doc-drift",
+                "pragma", "parse-error"}
+    assert expected <= set(RULES)
+
+
+def test_analysis_package_is_jax_free():
+    # bin/dstpu_lint loads analysis/ by path precisely so it runs without
+    # jax; an `import jax` sneaking into any module would break that
+    adir = os.path.join(PKG, "analysis")
+    for name in os.listdir(adir):
+        if name.endswith(".py"):
+            with open(os.path.join(adir, name)) as f:
+                src = f.read()
+            assert "import jax" not in src, f"analysis/{name} imports jax"
+
+
+def test_syntax_error_is_a_finding_not_a_skip(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": "def broken(:\n"})
+    res = run_lint(pkg)
+    assert findings_for(res, "parse-error")
+
+
+# ---------------------------------------------------------------------------
+# wall-clock-verdict
+
+
+def test_wall_clock_flags_time_time_in_verdict_dir(tmp_path):
+    pkg = make_tree(tmp_path, {"resilience/x.py": """\
+        import time
+        def stale(last):
+            return time.time() - last > 5.0
+    """})
+    res = run_lint(pkg, rule_ids=["wall-clock-verdict"])
+    (f,) = findings_for(res, "wall-clock-verdict")
+    assert f.line == 3 and "verdict-path" in f.message
+
+
+def test_wall_clock_flags_from_import_alias(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        from time import time as now
+        t0 = now()
+    """})
+    res = run_lint(pkg, rule_ids=["wall-clock-verdict"])
+    assert len(findings_for(res, "wall-clock-verdict")) == 1
+
+
+def test_wall_clock_ignores_monotonic(tmp_path):
+    pkg = make_tree(tmp_path, {"resilience/x.py": """\
+        import time
+        def stale(last):
+            return time.monotonic() - last > 5.0
+    """})
+    res = run_lint(pkg, rule_ids=["wall-clock-verdict"])
+    assert not findings_for(res, "wall-clock-verdict")
+
+
+def test_wall_clock_pragma_with_rationale_suppresses(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        import time
+        stamp = time.time()  # dstpu: allow[wall-clock-verdict] -- log timestamp
+    """})
+    res = run_lint(pkg, rule_ids=["wall-clock-verdict"])
+    assert not findings_for(res, "wall-clock-verdict")
+    assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# broad-except
+
+
+def test_broad_except_flags_swallowing_handlers(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+        def g():
+            try:
+                work()
+            except:
+                return None
+    """})
+    res = run_lint(pkg, rule_ids=["broad-except"])
+    assert len(findings_for(res, "broad-except")) == 2
+
+
+def test_broad_except_allows_reraise_and_typed_mapping(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        def f():
+            try:
+                work()
+            except Exception:
+                cleanup()
+                raise
+        def g():
+            try:
+                work()
+            except Exception as e:
+                raise CheckpointCorruptError(str(e)) from e
+    """})
+    res = run_lint(pkg, rule_ids=["broad-except"])
+    assert not findings_for(res, "broad-except")
+
+
+def test_broad_except_exempts_import_probes(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        try:
+            import optional_backend
+            HAVE = True
+        except Exception:
+            HAVE = False
+        def probe():
+            import importlib
+            try:
+                return importlib.import_module("maybe")
+            except Exception:
+                return None
+    """})
+    res = run_lint(pkg, rule_ids=["broad-except"])
+    assert not findings_for(res, "broad-except")
+
+
+def test_broad_except_stdlib_import_does_not_exempt_real_work(tmp_path):
+    # a stray stdlib import must not excuse a swallowing handler around
+    # genuinely risky work (code-review finding on the first cut)
+    pkg = make_tree(tmp_path, {"x.py": """\
+        def f():
+            try:
+                import json
+                risky_network_call()
+            except Exception:
+                pass
+    """})
+    res = run_lint(pkg, rule_ids=["broad-except"])
+    assert len(findings_for(res, "broad-except")) == 1
+
+
+def test_broad_except_standalone_pragma_suppresses_next_line(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        def f():
+            try:
+                work()
+            # dstpu: allow[broad-except] -- supervisor loop must outlive anything
+            except Exception:
+                pass
+    """})
+    res = run_lint(pkg, rule_ids=["broad-except"])
+    assert not findings_for(res, "broad-except")
+    assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+
+
+def test_blocking_under_lock_flags_the_hazards(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        import subprocess
+        import threading
+        import time
+        lock = threading.Lock()
+        def f(sock, out):
+            with lock:
+                time.sleep(0.1)
+                data = sock.recv(1024)
+                conn, _ = sock.accept()
+                subprocess.run(["ls"])
+                out.block_until_ready()
+    """})
+    res = run_lint(pkg, rule_ids=["blocking-under-lock"])
+    assert len(findings_for(res, "blocking-under-lock")) == 5
+
+
+def test_blocking_under_lock_names_the_lock_in_multi_item_with(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        import time
+        def f(self, path):
+            with open(path) as fh, self._lock:
+                time.sleep(0.1)
+    """})
+    res = run_lint(pkg, rule_ids=["blocking-under-lock"])
+    (f,) = findings_for(res, "blocking-under-lock")
+    assert "self._lock" in f.message and "open(" not in f.message
+
+
+def test_blocking_under_lock_ignores_outside_and_nested_defs(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        import threading
+        import time
+        def f(self):
+            time.sleep(0.1)  # not under a lock
+            with self._lock:
+                x = 1
+                def deferred():
+                    time.sleep(0.1)  # runs later, not under the lock
+                return x
+            with open("f") as fh:  # not a lock
+                time.sleep(0.1)
+    """})
+    res = run_lint(pkg, rule_ids=["blocking-under-lock"])
+    assert not findings_for(res, "blocking-under-lock")
+
+
+# ---------------------------------------------------------------------------
+# unguarded-donation
+
+
+def test_donation_outside_helper_flags(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        import jax
+        step = jax.jit(lambda s: s, donate_argnums=(0,))
+        named = jax.jit(lambda s: s, donate_argnames=("s",))
+    """})
+    res = run_lint(pkg, rule_ids=["unguarded-donation"])
+    assert len(findings_for(res, "unguarded-donation")) == 2
+
+
+def test_donation_through_helper_and_helper_module_pass(tmp_path):
+    pkg = make_tree(tmp_path, {
+        "x.py": """\
+            from .utils.donation import donated_jit
+            step = donated_jit(lambda s: s, donate_argnums=(0,))
+        """,
+        "utils/donation.py": """\
+            import jax
+            def donated_jit(fun, *, donate_argnums=(), **kw):
+                return jax.jit(fun, donate_argnums=donate_argnums, **kw)
+        """,
+    })
+    res = run_lint(pkg, rule_ids=["unguarded-donation"])
+    assert not findings_for(res, "unguarded-donation")
+
+
+# ---------------------------------------------------------------------------
+# rename-durability
+
+
+def test_rename_without_fsync_flags(tmp_path):
+    pkg = make_tree(tmp_path, {"checkpoint/x.py": """\
+        import os
+        def commit(tmp, path):
+            os.replace(tmp, path)
+    """})
+    res = run_lint(pkg, rule_ids=["rename-durability"])
+    (f,) = findings_for(res, "rename-durability")
+    assert "commit" in f.message
+
+
+def test_rename_flags_pathlib_spelling_but_not_str_replace(tmp_path):
+    pkg = make_tree(tmp_path, {"checkpoint/x.py": """\
+        from pathlib import Path
+        def commit(tmp: Path, dst):
+            tmp.replace(dst)
+        def harmless(name: str):
+            return name.replace("/", "_")
+    """})
+    res = run_lint(pkg, rule_ids=["rename-durability"])
+    (f,) = findings_for(res, "rename-durability")
+    assert f.line == 3 and "tmp.replace" in f.message
+
+
+def test_rename_with_fsync_or_durable_helper_passes(tmp_path):
+    pkg = make_tree(tmp_path, {"checkpoint/x.py": """\
+        import os
+        def commit(tmp, path):
+            fd = os.open(tmp, os.O_RDONLY)
+            os.fsync(fd)
+            os.replace(tmp, path)
+        def commit2(tmp, path, data):
+            _write_durable(tmp, data)
+            os.rename(tmp, path)
+    """})
+    res = run_lint(pkg, rule_ids=["rename-durability"])
+    assert not findings_for(res, "rename-durability")
+
+
+# ---------------------------------------------------------------------------
+# pragma contract
+
+
+def test_pragma_without_rationale_is_rejected_and_does_not_suppress(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        import time
+        t = time.time()  # dstpu: allow[wall-clock-verdict]
+    """})
+    res = run_lint(pkg, rule_ids=["wall-clock-verdict"])
+    # the original finding survives AND the malformed pragma is a finding
+    assert len(findings_for(res, "wall-clock-verdict")) == 1
+    (p,) = findings_for(res, "pragma")
+    assert "rationale" in p.message
+
+
+def test_pragma_with_unknown_rule_id_is_rejected(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        x = 1  # dstpu: allow[no-such-rule] -- misremembered id
+    """})
+    res = run_lint(pkg)
+    (p,) = findings_for(res, "pragma")
+    assert "unknown rule id" in p.message
+
+
+def test_markdown_pragmas_validated_even_on_a_clean_tree(tmp_path):
+    # a rationale-less doc pragma must be a finding NOW, not spring one at
+    # whoever causes the first drift there (code-review finding)
+    pkg = make_tree(
+        tmp_path, {"x.py": "VALUE = 1\n"},
+        docs={"config.md": """\
+            # Config
+            <!-- dstpu: allow[config-doc-drift] -->
+        """})
+    res = run_lint(pkg)
+    (p,) = findings_for(res, "pragma")
+    assert "rationale" in p.message and p.path.endswith("config.md")
+
+
+# ---------------------------------------------------------------------------
+# config-doc-drift (seeded mismatches, both directions)
+
+
+_CONFIG_FIXTURE = """\
+    from dataclasses import dataclass
+
+    @dataclass
+    class FooConfig:
+        alpha: int = 1
+        beta: int = 2
+"""
+
+
+def test_config_drift_catches_undocumented_field(tmp_path):
+    pkg = make_tree(
+        tmp_path, {"runtime/config.py": _CONFIG_FIXTURE},
+        docs={"config.md": """\
+            # Config
+            | key | meaning |
+            |---|---|
+            | `alpha` | documented |
+        """})
+    res = run_lint(pkg, rule_ids=["config-doc-drift"])
+    (f,) = findings_for(res, "config-doc-drift")
+    assert "FooConfig.beta" in f.message and f.path.endswith("config.py")
+
+
+def test_config_drift_catches_stale_doc_key(tmp_path):
+    pkg = make_tree(
+        tmp_path, {"runtime/config.py": _CONFIG_FIXTURE},
+        docs={"config.md": """\
+            # Config (`alpha`, `beta` live here)
+            | key | meaning |
+            |---|---|
+            | `gamma` | the code moved on |
+        """})
+    res = run_lint(pkg, rule_ids=["config-doc-drift"])
+    (f,) = findings_for(res, "config-doc-drift")
+    assert "`gamma`" in f.message and f.path.endswith("config.md")
+
+
+def test_config_drift_clean_when_in_sync(tmp_path):
+    pkg = make_tree(
+        tmp_path, {"runtime/config.py": _CONFIG_FIXTURE},
+        docs={"config.md": """\
+            # Config
+            | key | meaning |
+            |---|---|
+            | `alpha` | documented |
+            | `foo.beta` | dotted spelling works |
+        """})
+    res = run_lint(pkg, rule_ids=["config-doc-drift"])
+    assert not findings_for(res, "config-doc-drift")
+
+
+# ---------------------------------------------------------------------------
+# metric-doc-drift (seeded mismatches, both directions)
+
+
+_METRIC_DOC_FIXTURE = """\
+    # Observability
+    | name | kind | meaning |
+    |---|---|---|
+    | `serving/documented` | counter | fine |
+    | `serving/bucket[N]` | counter | per-bucket family |
+    | `rpc/<op>` | counter | dynamic family |
+    | `serving/ghost` | gauge | nothing constructs this |
+"""
+
+
+def test_metric_drift_catches_undocumented_metric(tmp_path):
+    pkg = make_tree(
+        tmp_path, {"m.py": """\
+            def f(reg, name):
+                reg.counter("serving/documented").inc()
+                reg.counter("serving/not_documented").inc()
+                reg.counter("serving/bucket[16]").inc()
+                reg.counter(f"rpc/{name}").inc()
+                reg.gauge("serving/ghost").set(1)
+        """},
+        docs={"observability.md": _METRIC_DOC_FIXTURE})
+    res = run_lint(pkg, rule_ids=["metric-doc-drift"])
+    (f,) = findings_for(res, "metric-doc-drift")
+    assert "serving/not_documented" in f.message and f.path.endswith("m.py")
+
+
+def test_metric_drift_catches_stale_catalog_row(tmp_path):
+    pkg = make_tree(
+        tmp_path, {"m.py": """\
+            def f(reg):
+                reg.counter("serving/documented").inc()
+        """},
+        docs={"observability.md": """\
+            # Observability
+            | name | kind | meaning |
+            |---|---|---|
+            | `serving/documented` | counter | fine |
+            | `serving/ghost` | gauge | nothing constructs this |
+        """})
+    res = run_lint(pkg, rule_ids=["metric-doc-drift"])
+    (f,) = findings_for(res, "metric-doc-drift")
+    assert "`serving/ghost`" in f.message and f.path.endswith(".md")
+
+
+def test_metric_drift_markdown_pragma_suppresses_row(tmp_path):
+    pkg = make_tree(
+        tmp_path, {"m.py": """\
+            def f(reg):
+                reg.counter("serving/documented").inc()
+        """},
+        docs={"observability.md": """\
+            # Observability
+            | name | kind | meaning |
+            |---|---|---|
+            | `serving/documented` | counter | fine |
+            <!-- dstpu: allow[metric-doc-drift] -- retired metric, kept for dashboard history -->
+            | `serving/ghost` | gauge | nothing constructs this |
+        """})
+    res = run_lint(pkg, rule_ids=["metric-doc-drift"])
+    assert not findings_for(res, "metric-doc-drift")
+    assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# the whole-tree clean gate (the acceptance criterion)
+
+
+def test_the_tree_is_clean():
+    res = run_lint(PKG)
+    assert res.clean, "dstpu-lint findings on the tree:\n" + "\n".join(
+        f"  {f.location}: [{f.rule}] {f.message}" for f in res.findings)
+    # the pragma inventory is real work, not an accident — if this drops
+    # to zero the suppression machinery itself probably broke
+    assert len(res.suppressed) >= 10
+    assert res.files_checked > 100
+
+
+# ---------------------------------------------------------------------------
+# CLI contract: 0 clean / 1 findings / 2 usage
+
+
+def _cli(*args, cwd=REPO):
+    return subprocess.run([sys.executable, LINT, *args],
+                          capture_output=True, text=True, cwd=cwd,
+                          timeout=120)
+
+
+@pytest.fixture(scope="module")
+def dirty_pkg(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("lint_cli")
+    return make_tree(tmp, {"resilience/x.py": """\
+        import time
+        def stale(last):
+            return time.time() - last > 5.0
+    """})
+
+
+def test_cli_exit_1_on_findings_and_json_format(dirty_pkg):
+    proc = _cli(dirty_pkg, "--format", "json")
+    assert proc.returncode == 1, proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["findings"] and data["findings"][0]["rule"] == "wall-clock-verdict"
+
+
+def test_cli_exit_0_on_clean_tree(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": "VALUE = 1\n"})
+    proc = _cli(pkg)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_exit_2_on_usage_errors(dirty_pkg):
+    assert _cli("/no/such/path").returncode == 2
+    assert _cli(dirty_pkg, "--rule", "no-such-rule").returncode == 2
+
+
+def test_cli_rule_selection(dirty_pkg):
+    # the only violation is wall-clock; selecting another rule reports clean
+    proc = _cli(dirty_pkg, "--rule", "broad-except")
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_cli_baseline_freezes_then_fails_only_on_new(dirty_pkg, tmp_path):
+    base = str(tmp_path / "baseline.json")
+    assert _cli(dirty_pkg, "--write-baseline", base).returncode == 0
+    # frozen: same findings, exit 0
+    proc = _cli(dirty_pkg, "--baseline", base)
+    assert proc.returncode == 0, proc.stdout
+    assert "baselined" in proc.stdout
+    # a NEW violation in another file fails even with the baseline
+    with open(os.path.join(dirty_pkg, "resilience", "y.py"), "w") as f:
+        f.write("import time\nT = time.time()\n")
+    proc = _cli(dirty_pkg, "--baseline", base)
+    assert proc.returncode == 1
+    assert "y.py" in proc.stdout
+    os.unlink(os.path.join(dirty_pkg, "resilience", "y.py"))
+
+
+def test_cli_real_tree_is_clean_with_zero_baseline_entries():
+    # the acceptance criterion: bin/dstpu_lint deepspeed_tpu/ exits 0 with
+    # NO baseline — every pre-existing finding was fixed or pragma'd
+    proc = _cli(PKG)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
