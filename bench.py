@@ -48,6 +48,18 @@ ASSERTS the resilience contract — every request reaches a terminal status,
 clean replay finished), and no slot leaks (occupancy gauge back to 0, every
 non-quarantined slot back in the free pool). Prints one JSON line.
 
+Surge drill (``python bench.py --surge [n_requests] [--surge-seed N]``, CI
+tier): the self-healing elastic fleet end-to-end — real worker processes
+behind the Router + the ledger-driven Autoscaler, an open-loop bursty
+trace with heavy-tail prompt lengths and mixed priorities, and a
+mid-trace worker SIGKILL. ASSERTS the elasticity contract: the fleet
+grows to max under the burst, the killed worker is recovered (supervisor
+respawn + attach as a NEW replica), the fleet shrinks back to min after
+the burst, every accepted request reaches a terminal state with greedy
+parity on the completed set, brownout engaged while saturated at max, and
+no worker compiled a second decode program. Prints one JSON line with
+scale/respawn/brownout/shed counts and p99 TTFT.
+
 Chaos soak drill (``python bench.py --chaos [steps] [--chaos-seed N]``, CI
 tier): a supervisor loop trains a tiny model to a target step count under
 seeded random preemptions (each takes a just-in-time ``preempt``-tag
@@ -678,6 +690,229 @@ def _chaos_serving(seed: int) -> int:
         sup.shutdown()
 
 
+def _surge(n_requests: int, seed: int) -> int:
+    """Trace-driven surge/failure drill (``bench.py --surge [n]``): the
+    self-healing elastic fleet end-to-end. One REAL worker process behind
+    the Router + a ledger-driven Autoscaler over the WorkerSupervisor; an
+    open-loop trace (two bursts, heavy-tail prompt lengths, mixed
+    priorities) drives arrivals while one worker is SIGKILL'd mid-trace.
+    ASSERTS: the autoscaler grows the fleet to max under the burst,
+    recovers the killed worker (supervisor respawn + attach as a NEW rid),
+    shrinks back to min after the burst, every ACCEPTED request reaches a
+    terminal state, completed (ok) greedy streams are BITWISE the
+    unfaulted single-engine run's, brownout engaged while saturated at
+    max, and no worker compiled a second decode program (watchdog RAISE
+    everywhere). Emits one JSON row with scale/respawn/brownout/shed
+    counts and p99 TTFT. CPU-pinned correctness soak, never a trajectory
+    datapoint."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests", ".xla_cache"))
+    import signal
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.inference import Autoscaler, InferenceEngine, Router
+    from deepspeed_tpu.inference.serving import Request, ServingEngine
+    from deepspeed_tpu.launcher.serving_worker import WorkerSupervisor
+    from deepspeed_tpu.resilience import RequestRejected
+
+    t0 = time.perf_counter()
+    serving_cfg = {
+        "n_slots": 2, "max_seq_len": 128, "watchdog_mode": "raise",
+        "chunked_prefill": {"enabled": True, "chunk_size": 16},
+    }
+    model_spec = {"vocab_size": 97, "max_seq_len": 128, "num_layers": 2,
+                  "num_heads": 4, "hidden_size": 32, "dtype": "float32",
+                  "loss_chunk_size": 0, "decode_attn": "xla",
+                  "pos_emb": "rotary"}
+    spec = {"model": model_spec, "engine_dtype": "fp32",
+            "serving": serving_cfg}
+
+    # -- the trace: bursty arrivals, heavy-tail prompts, mixed priorities.
+    # Worker boots are ASYNC (the fleet keeps serving while one boots, ~3s
+    # each), so the pressure must be sustained — burst A trips the first
+    # scale-up, burst B holds the up-signal through the serial boots (and
+    # the post-kill respawn), burst C's high-priority stragglers land on
+    # the saturated, browned-out fleet: the priority-shed path's bait.
+    rng = np.random.default_rng(seed)
+    n_a = max(4, int(n_requests * 0.3))           # burst A at t ~ 0
+    n_c = max(2, int(n_requests * 0.2))           # high-priority burst C
+    n_b = max(4, n_requests - n_a - n_c)          # burst B mid-trace
+    prompts, priorities, offsets = {}, {}, {}
+    for uid in range(n_a + n_b + n_c):
+        heavy = rng.random() < 0.2                # heavy-tail prompt length
+        prompts[uid] = rng.integers(
+            0, 97, size=int(rng.integers(48, 90) if heavy
+                            else rng.integers(5, 24))).astype(np.int32)
+        if uid < n_a:
+            offsets[uid] = float(rng.uniform(0.0, 0.3))
+            priorities[uid] = int(rng.integers(0, 2))
+        elif uid < n_a + n_b:
+            offsets[uid] = float(rng.uniform(2.5, 3.2))
+            priorities[uid] = int(rng.integers(0, 2))
+        else:
+            offsets[uid] = float(rng.uniform(4.5, 5.5))
+            priorities[uid] = 2
+
+    def mk(uid, arrival=0.0):
+        return Request(uid=uid, prompt=prompts[uid], max_new_tokens=32,
+                       arrival_time=arrival, priority=priorities[uid])
+
+    # -- unfaulted single-engine reference (identical PRNGKey(0) params) --
+    from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+    cfg = TransformerConfig(**{**model_spec, "dtype": jnp.float32})
+    ref_srv = ServingEngine(
+        InferenceEngine(model=Model(cfg), config={"dtype": "fp32"}),
+        config=serving_cfg)
+    for uid in sorted(prompts):
+        ref_srv.submit(mk(uid))
+    ref = {u: r.tokens for u, r in ref_srv.drain().items()}
+
+    sup = WorkerSupervisor(
+        spec, 1,
+        transport={"call_timeout_s": 120.0, "boot_timeout_s": 300.0,
+                   "heartbeat_timeout_s": 30.0, "base_delay_s": 0.05,
+                   "max_delay_s": 0.2, "jitter": 0.0},
+        respawn_backoff={"max_attempts": 10, "base_delay_s": 0.2,
+                         "max_delay_s": 1.0, "jitter": 0.25},
+        seed=seed)
+    try:
+        clients = sup.start()
+        router = Router(
+            config={"router": {
+                "replicas": 1, "max_queue_len": 12,
+                "health": {"timeout": 60.0},
+                "autoscale": {
+                    "enabled": True, "min_replicas": 1, "max_replicas": 3,
+                    "scale_up_queue": 3, "scale_up_load": 3.0,
+                    "scale_down_load": 0.5, "up_consecutive": 2,
+                    "down_consecutive": 8, "cooldown_s": 0.75,
+                    "brownout_deadline_s": 60.0},
+            }},
+            replica_engines=clients)
+        asc = Autoscaler(router, supervisor=sup, slots={0: 0})
+
+        def healthy_n():
+            return sum(1 for s in router.replica_states().values()
+                       if s == "healthy")
+
+        now0 = router.now()
+        arrivals = sorted(
+            (mk(uid, arrival=now0 + offsets[uid]) for uid in prompts),
+            key=lambda r: r.arrival_time)
+        kill_at = now0 + 2.0
+        submitted, rejected = set(), {}
+        killed_slot = None
+        max_healthy = 1
+        deadline = time.monotonic() + 420.0
+        while arrivals or not submitted <= set(router.results):
+            assert time.monotonic() < deadline, (
+                "surge drill wall-clock cap exceeded",
+                sorted(submitted - set(router.results)))
+            now = router.now()
+            while arrivals and arrivals[0].arrival_time <= now:
+                req = arrivals.pop(0)
+                try:
+                    router.submit(req)
+                    submitted.add(req.uid)
+                except RequestRejected as e:
+                    rejected[req.uid] = e.reason
+            if (killed_slot is None and now >= kill_at and healthy_n() >= 2
+                    and router._owner):
+                victim_rid = router.owner_of(next(iter(router._owner)))
+                if victim_rid is not None and asc.slot_of(victim_rid) is not None:
+                    killed_slot = asc.slot_of(victim_rid)
+                    sup.kill(killed_slot, signal.SIGKILL)
+            router.step()
+            max_healthy = max(max_healthy, healthy_n())
+            if all(r.engine.idle for r in router._replicas if r.stepped):
+                # idle trough between bursts: pace the loop like a real
+                # serving driver instead of hot-spinning state polls
+                time.sleep(0.01)
+
+        # feed the MFU signal path once through a real fleet snapshot
+        # (unrated on CPU: the signal stays null, the plumbing is exercised)
+        asc.observe(router.telemetry_snapshot())
+
+        # -- post-burst: the fleet must shrink back to min ----------------
+        # (a boot that landed just as the last request finished still
+        # counts toward the peak — the fleet DID grow to it)
+        shrink_deadline = time.monotonic() + 120.0
+        while (healthy_n() > 1 or asc._boots
+               or any(s == "draining"
+                      for s in router.replica_states().values())):
+            assert time.monotonic() < shrink_deadline, (
+                "fleet never scaled back down", router.replica_states())
+            router.step()
+            max_healthy = max(max_healthy, healthy_n())
+            time.sleep(0.02)
+
+        counters = router.telemetry.registry.snapshot()["counters"]
+        asc_c = {k.rsplit("/", 1)[1]: int(v) for k, v in counters.items()
+                 if k.startswith("router/autoscale/")}
+
+        # -- the elastic contract, asserted -------------------------------
+        assert max_healthy >= 3, (
+            f"fleet never grew to max under the burst (peak {max_healthy})")
+        assert killed_slot is not None, "the mid-trace SIGKILL never fired"
+        assert sup.respawns >= 1 and asc_c.get("respawns", 0) >= 1, (
+            "the killed worker was never recovered", asc_c)
+        assert asc_c.get("scale_ups", 0) >= 2, asc_c
+        assert asc_c.get("scale_downs", 0) >= 1, asc_c
+        assert asc_c.get("brownouts", 0) >= 1, (
+            "the saturated-at-max window never browned out", asc_c)
+        assert healthy_n() == 1 and asc.target == 1
+        missing = sorted(submitted - set(router.results))
+        assert not missing, f"accepted requests without a terminal state: {missing}"
+        ok_uids = [u for u in submitted if router.results[u].ok]
+        for u in ok_uids:
+            np.testing.assert_array_equal(
+                router.results[u].tokens, ref[u],
+                err_msg=f"uid {u} diverged from the unfaulted run")
+        # watchdog RAISE held on every reachable worker: ONE decode program
+        for rid, state in router.replica_states().items():
+            if state == "healthy":
+                assert router._replicas[rid].engine.compile_counts()[
+                    "decode"] == 1, rid
+
+        from collections import Counter as _Counter
+
+        statuses = _Counter(router.results[u].status for u in submitted)
+        ttfts = sorted(router.results[u].ttft for u in ok_uids)
+        p99 = ttfts[min(len(ttfts) - 1,
+                        int(0.99 * (len(ttfts) - 1) + 0.5))] if ttfts else None
+        print(json.dumps({
+            "metric": "serving surge drill (autoscale events)",
+            "value": int(asc_c.get("scale_ups", 0)
+                         + asc_c.get("scale_downs", 0)
+                         + asc_c.get("respawns", 0)),
+            "unit": "events",
+            # CPU-pinned correctness soak: never a trajectory datapoint
+            "platform": "cpu",
+            "comparable": False,
+            "mfu": None,
+            "roofline": "unrated:cpu",
+            "n_requests": len(prompts),
+            "accepted": len(submitted),
+            "rejected_at_submit": dict(
+                _Counter(rejected.values())) if rejected else {},
+            "statuses": dict(statuses),
+            "max_healthy": max_healthy,
+            "autoscale": asc_c,
+            "respawns": sup.respawns,
+            "greedy_bitwise_match_ok_set": True,
+            "ttft_p99_s": None if p99 is None else round(p99, 3),
+            "seed": seed,
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+        }), flush=True)
+        return 0
+    finally:
+        sup.shutdown()
+
+
 def _stamp_row(obj, stage):
     """Backend provenance on EVERY bench row: ``platform`` plus a
     ``comparable`` verdict — False when the row ran on a fallback backend
@@ -873,6 +1108,28 @@ if __name__ == "__main__":
                   file=sys.stderr)
             sys.exit(2)
         sys.exit(_fault_smoke(rate))
+    if "--surge" in sys.argv:
+        # usage-error exit 2 on malformed values (same contract as
+        # --chaos/--chaos-serving): --surge [n_requests >= 12] [--surge-seed N]
+        try:
+            idx = sys.argv.index("--surge")
+            n_requests = 30
+            if idx + 1 < len(sys.argv) and not sys.argv[idx + 1].startswith("--"):
+                # "--"-prefixed means the next FLAG; a bare "-3" is a (bad)
+                # operand and must hit the usage check, not be ignored
+                n_requests = int(sys.argv[idx + 1])
+            surge_seed = 0
+            if "--surge-seed" in sys.argv:
+                surge_seed = int(sys.argv[sys.argv.index("--surge-seed") + 1])
+            if n_requests < 12:
+                raise ValueError(
+                    "n_requests must be >= 12 (room for two bursts + the "
+                    "high-priority stragglers)")
+        except (IndexError, ValueError) as e:
+            print(f"usage: bench.py --surge [n_requests >= 12] "
+                  f"[--surge-seed <int>] ({e})", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_surge(n_requests, surge_seed))
     if "--chaos-serving" in sys.argv:
         # usage-error exit 2 on malformed values (same contract as --chaos)
         try:

@@ -263,6 +263,65 @@ def check_unguarded_donation(pf: PyFile) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# socket-discipline — PR 8: a socket call without a deadline hangs the
+# caller forever (the Router's verdict machine starves, the supervisor
+# never fires); machine-enforced before the TCP transport landed
+
+
+_SOCKET_IO = {"connect", "accept", "recv", "recv_into", "recvfrom",
+              "recvmsg"}
+
+
+def _is_socket_ctor(node: ast.Call) -> bool:
+    f = node.func
+    # socket.socket(...) / sock_mod.socket(...) — the attribute spelling
+    if isinstance(f, ast.Attribute) and f.attr == "socket":
+        return _root_name(f) == "socket"
+    # from socket import socket; socket(...) — the bare-name spelling
+    return isinstance(f, ast.Name) and f.id == "socket"
+
+
+@rule("socket-discipline",
+      "a scope that constructs socket.socket(...) and drives blocking I/O "
+      "on it (connect/accept/recv*) must put a deadline in scope — a "
+      "settimeout(...) call or an explicit deadline variable (PR 8 hang "
+      "lesson: an undeadlined socket starves the verdict machine)")
+def check_socket_discipline(pf: PyFile) -> list[Finding]:
+    out = []
+    funcs = None
+    for node in ast.walk(pf.tree):
+        if not (isinstance(node, ast.Call) and _is_socket_ctor(node)):
+            continue
+        if funcs is None:
+            funcs = _enclosing_functions(pf.tree)
+        enclosing = _innermost_function(funcs, node.lineno)
+        scope: ast.AST = enclosing if enclosing is not None else pf.tree
+        io_calls = [n for n in _walk_same_scope(scope)
+                    if isinstance(n, ast.Call)
+                    and _terminal_name(n.func) in _SOCKET_IO]
+        if not io_calls:
+            continue  # bind/listen-only construction: accept loops carry
+            #           their own deadline where they live
+        has_deadline = any(
+            (isinstance(n, ast.Call)
+             and _terminal_name(n.func) in ("settimeout", "setblocking"))
+            or (isinstance(n, ast.Name) and "deadline" in n.id.lower())
+            or (isinstance(n, ast.arg) and "deadline" in n.arg.lower())
+            for n in ast.walk(scope))
+        if has_deadline:
+            continue
+        where = (f"function {enclosing.name}()" if enclosing is not None
+                 else "module scope")
+        out.append(Finding(
+            "socket-discipline", pf.rel, node.lineno,
+            f"socket.socket(...) in {where} drives "
+            f"{'/'.join(sorted({_terminal_name(n.func) for n in io_calls}))} "
+            f"with no settimeout/deadline in scope — an undeadlined socket "
+            f"call can hang forever; set a timeout or thread a deadline"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # rename-durability — PR 4 round 3: a rename that commits state must be
 # fsync-disciplined or a crash can surface a half-visible checkpoint
 

@@ -1,5 +1,6 @@
 """Inference stack (reference: deepspeed/inference/)."""
 
+from .autoscaler import Autoscaler
 from .engine import InferenceEngine
 from .router import Router
 from .rpc import ReplicaClient, RpcClient, RpcServer

@@ -71,7 +71,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Optional
 
 import numpy as np
@@ -105,6 +105,8 @@ class _Replica:
     failed_over: int = 0     # requests moved OFF on a dead/hung verdict
     drained: int = 0         # queued requests migrated off at drain time
     completed: int = 0       # terminal results recorded from this replica
+    last_step_sec: float = 0.0  # latest non-compiling step latency
+    #                           (the autoscaler's saturation signal)
 
     @property
     def accepts(self) -> bool:
@@ -186,6 +188,11 @@ class Router:
         # ONE sink at the router — N replicas appending to one JSONL path
         # would interleave half-written lines
         sub.pop("jsonl_path", None)
+        # kept for runtime growth: the autoscaler's in-process scale-up
+        # path builds more replicas from the same engine + per-replica
+        # config the constructor used
+        self._base_engine = engine
+        self._sub_config = sub
         self._replicas: list[_Replica] = []
         if replica_engines is not None:
             for rid, e in enumerate(replica_engines):
@@ -221,6 +228,12 @@ class Router:
         # next step()'s return so the terminal-uid contract stays complete
         self._pending_terminal: list[int] = []
         self._steps = 0
+        # overload brownout (docs/serving.md "Elastic fleet & brownout"):
+        # driven by the autoscaler when the fleet is at max and still
+        # saturated; degrades submit gracefully instead of shedding blindly
+        self._brownout = False
+        self._brownout_deadline_s = 0.0
+        self._autoscaler = None
         self.telemetry.gauge("router/replicas").set(rc.replicas)
         self._update_gauges()
         log_dist(
@@ -228,6 +241,13 @@ class Router:
             f"{self.health.timeout}s, affinity={self.affinity}, "
             f"global max_queue_len={self.max_queue_len or 'unbounded'}",
             ranks=[0])
+        if rc.autoscale.enabled and replica_engines is None:
+            # in-process fleets close the elasticity loop by themselves;
+            # process-mode fleets construct an Autoscaler around their
+            # WorkerSupervisor instead (it binds itself here)
+            from .autoscaler import Autoscaler
+
+            Autoscaler(self, rc.autoscale)
 
     # -- dispatch --------------------------------------------------------
 
@@ -252,7 +272,15 @@ class Router:
         """Route a request to the best healthy replica. Raises typed
         ``RequestRejected`` when no replica accepts dispatch
         (``no_healthy_replicas``) or the GLOBAL arrived-queue bound is hit
-        (``queue_full``); per-replica bounds may still reject underneath."""
+        (``queue_full`` — or ``overloaded`` during brownout, the typed
+        back-off hint); per-replica bounds may still reject underneath.
+
+        Brownout degradation ladder (docs/serving.md): deadline-free
+        requests are tightened onto the brownout deadline; a full queue
+        sheds the lowest-priority NEWEST queued request to admit a
+        higher-priority arrival; only when nothing queued is lower
+        priority does the arrival itself bounce — typed ``overloaded`` so
+        clients know to back off rather than hammer a saturated fleet."""
         tm = self.telemetry
         healthy = self._accepting()
         if not healthy:
@@ -261,13 +289,30 @@ class Router:
                 request.uid, "no_healthy_replicas",
                 f"0 of {len(self._replicas)} replicas accepting dispatch")
         now = time.perf_counter() - self._epoch
+        if (self._brownout and self._brownout_deadline_s > 0
+                and request.deadline_s <= 0):
+            # ladder rung 1: a browned-out fleet grants no open-ended
+            # latency budgets — deadline-free work gets the brownout
+            # deadline so a saturated backlog self-limits instead of
+            # growing stale entries forever
+            request = replace(request,
+                              deadline_s=self._brownout_deadline_s)
+            tm.counter("router/autoscale/brownout_deadlines").inc()
         if self.max_queue_len and request.arrival_time <= now:
             # same population rule as the per-engine bound: requeued uids
             # (quarantine replays, failovers) sit outside the accounting
             arrived = sum(r.engine.arrived_queue_len(now)
                           for r in self._replicas if r.stepped)
-            if arrived >= self.max_queue_len:
+            if arrived >= self.max_queue_len and not (
+                    self._brownout and self._shed_lower_priority(request)):
                 tm.counter("router/shed").inc()
+                if self._brownout:
+                    tm.counter("router/autoscale/overloaded_rejects").inc()
+                    raise RequestRejected(
+                        request.uid, "overloaded",
+                        f"fleet browned out at max capacity ({arrived} "
+                        f"arrived across {len(healthy)} replicas, nothing "
+                        f"queued is lower priority) — back off and retry")
                 raise RequestRejected(
                     request.uid, "queue_full",
                     f"{arrived} arrived requests across {len(healthy)} "
@@ -331,6 +376,104 @@ class Router:
         self._record(r, uid)
         self._pending_terminal.append(uid)
         return True
+
+    def now(self) -> float:
+        """Seconds on the fleet clock (the epoch every replica is anchored
+        to) — arrival times, deadlines and autoscale cooldowns all read it."""
+        return time.perf_counter() - self._epoch
+
+    # -- overload brownout (docs/serving.md "Elastic fleet & brownout") --
+
+    @property
+    def brownout(self) -> bool:
+        return self._brownout
+
+    def set_brownout(self, on: bool, *, deadline_s: float = 0.0) -> None:
+        """Enter/leave overload brownout. The autoscaler flips this when
+        the fleet is at ``max_replicas`` and still saturated (and back once
+        the pressure clears); an operator may flip it manually. While on,
+        ``submit`` degrades gracefully — see the ladder in its docstring."""
+        on = bool(on)
+        if on and not self._brownout:
+            self.telemetry.counter("router/autoscale/brownouts").inc()
+            log_dist(
+                "router: BROWNOUT on ("
+                + (f"{deadline_s}s deadline for deadline-free requests, "
+                   if deadline_s else "no deadline tightening, ")
+                + "priority shedding armed)", ranks=[0])
+        elif not on and self._brownout:
+            log_dist("router: brownout lifted", ranks=[0])
+        self._brownout = on
+        self._brownout_deadline_s = float(deadline_s) if on else 0.0
+        self.telemetry.gauge("router/autoscale/brownout").set(1 if on else 0)
+
+    def _shed_lower_priority(self, request: Request) -> bool:
+        """Brownout ladder rung 2: make room for ``request`` by shedding
+        the lowest-priority NEWEST still-QUEUED request (admitted work —
+        prefill/decode already paid for — is never discarded). False when
+        nothing queued is lower priority than the arrival."""
+        victims = sorted(
+            (req for uid, req in self._requests.items()
+             if req.priority < request.priority
+             and self._owner.get(uid) is not None
+             and self._replicas[self._owner[uid]].stepped),
+            key=lambda r: (r.priority, -r.arrival_time, -r.uid))
+        for victim in victims[:8]:  # bounded withdraw probes per submit
+            r = self._replicas[self._owner[victim.uid]]
+            try:
+                w = r.engine.withdraw(victim.uid)
+            except RpcTimeout:
+                # the withdraw MAY have executed (the worker pops the uid
+                # and caches it; only the reply was lost) — if we walked
+                # away here, no engine would ever report the uid terminal
+                # and drain()/serve() would spin on it forever. Shed it
+                # anyway: either side's leftover copy is an orphan whose
+                # completion the owner map ignores (the documented
+                # lost-reply semantics submit dispatch follows)
+                w = victim
+            except RpcError:
+                # conn-loss/garble already paid the replay-safe retry; a
+                # second failure means the replica is dying — its DEAD
+                # verdict (next step) fails this uid over from router
+                # state, so nothing strands
+                continue
+            if w is None:
+                continue  # already admitted: finishes, not shed
+            self._owner.pop(victim.uid, None)
+            self._seen.pop(victim.uid, None)
+            self._failovers.pop(victim.uid, None)
+            self._synth_result(victim, "shed_brownout")
+            self._pending_terminal.append(victim.uid)
+            self.telemetry.counter("router/autoscale/brownout_shed").inc()
+            if self.tracer is not None:
+                self.tracer.record(victim.uid, "shed", reason="brownout",
+                                   priority=victim.priority)
+            log_dist(
+                f"router: brownout shed request {victim.uid} (priority "
+                f"{victim.priority}) for arrival {request.uid} (priority "
+                f"{request.priority})", ranks=[0])
+            return True
+        return False
+
+    def bind_autoscaler(self, autoscaler) -> None:
+        """Attach the autoscaler whose ``tick`` rides every ``step()`` and
+        whose decision ring the fleet snapshot carries."""
+        self._autoscaler = autoscaler
+
+    def mark_dead(self, rid: int) -> None:
+        """External dead verdict: a supervisor OBSERVED the replica's
+        worker process gone (a corpse is stronger evidence than any
+        transport timeout, including for a replica sitting on probation —
+        a dead process can never re-admit). Applies the dead verdict now:
+        in-flight work fails over immediately instead of waiting for the
+        next step's transport error or the probation backoff to play out.
+        No-op for replicas already dead or drained."""
+        r = self._replicas[rid]
+        if r.state in ("dead", "drained"):
+            return
+        log_dist(f"router: replica {rid} marked dead externally "
+                 f"(supervisor observed the worker process gone)", ranks=[0])
+        self._fail(r, "dead", self.now(), self._pending_terminal)
 
     # -- health / failover ----------------------------------------------
 
@@ -566,6 +709,7 @@ class Router:
                 # that is a false positive (same exclusion rule the
                 # engine's latency histograms apply via last_call_compiled)
                 tm.histogram("router/replica_step_sec").observe(latency)
+                r.last_step_sec = latency  # the autoscaler's latency signal
             # completions from this step are REAL even if the step then
             # draws a hung verdict — record before judging
             self._collect(r, uids, terminal)
@@ -582,6 +726,12 @@ class Router:
         tm.gauge("router/queue_depth").set(
             sum(r.engine.queue_len for r in self._replicas if r.stepped))
         self._update_gauges()
+        if self._autoscaler is not None:
+            # the elasticity loop closes here: every fleet step evaluates
+            # the scaling signals. Worker-process boots run on a
+            # background thread (a later tick attaches the new replica),
+            # so the fleet never stops stepping while one boots
+            self._autoscaler.tick(now)
         return terminal
 
     # -- draining / drivers ---------------------------------------------
@@ -700,6 +850,18 @@ class Router:
 
     # -- fleet membership ------------------------------------------------
 
+    def _spawn_inprocess(self) -> ServingEngine:
+        """One more in-process replica from the constructor's engine +
+        per-replica config — the autoscaler's default scale-up path for
+        fleets built from ``Router(engine, config=...)``. Same model, same
+        config ⇒ same XLA program shapes (cache hits, not new programs)."""
+        if self._base_engine is None:
+            raise ValueError(
+                "this fleet was built from prebuilt replica_engines; give "
+                "the autoscaler a spawn callable or a WorkerSupervisor")
+        return ServingEngine(self._base_engine, config=self._sub_config,
+                             replica_id=len(self._replicas))
+
     def attach_replica(self, engine) -> int:
         """Grow the fleet at runtime — the worker supervisor's respawn
         path: a SIGKILL'd worker's replacement process joins as a NEW
@@ -789,6 +951,8 @@ class Router:
                 **self.router_stats(),
                 **({"request_trace": self.tracer.events()}
                    if self.tracer is not None else {}),
+                **({"autoscale": self._autoscaler.describe()}
+                   if self._autoscaler is not None else {}),
             },
             "replicas": reps,
         }

@@ -9,6 +9,20 @@ in-process fleet deliberately deferred: a real worker crash is a vanished
 address space, not a raised exception, and a real hang gives the caller
 nothing at all.
 
+Address families — one frame layer, two transports:
+
+  * ``AF_UNIX`` (a filesystem path): same-host worker processes, the PR 8
+    default.
+  * ``AF_INET`` (``tcp://host:port``): replicas on separate hosts/meshes.
+    The SAME DSRP frames, per-call monotonic deadlines, bounded-backoff
+    reconnect and replay-safe step/withdraw discipline ride both families
+    — the lost-reply replay proof is parameterized over both in
+    tests/test_rpc.py. TCP sockets run ``TCP_NODELAY`` (a step call is one
+    small frame each way; Nagle would serialize the fleet on ACK delays)
+    and the injected ``rpc_conn_reset`` site closes with ``SO_LINGER(0)``
+    so the peer sees a genuine RST, not a graceful FIN — the TCP-flavored
+    reset the reconnect path must survive.
+
 Wire format — deliberately boring:
 
   * one frame = 12-byte header (``b"DSRP"`` magic + payload length +
@@ -84,6 +98,28 @@ _HEADER = struct.Struct("!4sII")  # magic, payload length, payload crc32
 _MAX_FRAME = 64 * 1024 * 1024  # a length past this is desync, not data
 
 
+def parse_address(addr) -> tuple[str, object]:
+    """``(family, target)`` for an RPC endpoint: a ``tcp://host:port``
+    string (or ``(host, port)`` pair) is the TCP family; any other string
+    is an AF_UNIX socket path."""
+    if isinstance(addr, (tuple, list)):
+        return "tcp", (str(addr[0]), int(addr[1]))
+    s = str(addr)
+    if s.startswith("tcp://"):
+        host, _, port = s[len("tcp://"):].rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"malformed tcp address {s!r} "
+                             "(want tcp://host:port)")
+        return "tcp", (host, int(port))
+    return "unix", s
+
+
+def format_address(family: str, target) -> str:
+    if family == "tcp":
+        return f"tcp://{target[0]}:{target[1]}"
+    return str(target)
+
+
 # -- value codec ------------------------------------------------------------
 
 def _enc_value(x):
@@ -127,6 +163,7 @@ def encode_request(req) -> dict:
         "eos_token": None if req.eos_token is None else int(req.eos_token),
         "arrival_time": float(req.arrival_time),
         "deadline_s": float(req.deadline_s),
+        "priority": int(getattr(req, "priority", 0)),
     }
 
 
@@ -212,7 +249,10 @@ def recv_frame(sock: socket.socket, timeout: Optional[float] = None) -> Any:
 # -- server -----------------------------------------------------------------
 
 class RpcServer:
-    """Single-threaded unix-socket RPC server (the worker side).
+    """Single-threaded RPC server (the worker side) over a unix socket
+    path or a ``tcp://host:port`` address (port 0 = OS-assigned; the
+    resolved address is ``self.address``, printed in the worker's ready
+    line so a supervisor can discover ephemeral ports).
 
     ``handlers`` maps method name -> callable(**kwargs). One frame is one
     dispatch; handler exceptions become error replies (the worker process
@@ -221,17 +261,29 @@ class RpcServer:
     honored at a frame boundary, and calls ``on_tick`` each loop (the
     worker touches its heartbeat file there)."""
 
-    def __init__(self, path: str, handlers: dict):
-        self.path = str(path)
+    def __init__(self, address, handlers: dict):
+        self.family, target = parse_address(address)
         self.handlers = dict(handlers)
-        import os
+        if self.family == "tcp":
+            self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET,
+                                      socket.SO_REUSEADDR, 1)
+            self._listener.bind(target)
+            host, port = self._listener.getsockname()[:2]
+            self.address = format_address("tcp", (host, port))
+        else:
+            import os
 
-        try:
-            os.unlink(self.path)
-        except OSError:
-            pass
-        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._listener.bind(self.path)
+            try:
+                os.unlink(target)
+            except OSError:
+                pass
+            self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._listener.bind(target)
+            self.address = target
+        # the historical attribute name; tcp servers expose the resolved
+        # tcp://host:port here too (callers build clients from it)
+        self.path = self.address
         self._listener.listen(8)
         self._clients: list[socket.socket] = []
         self.frames_served = 0
@@ -283,6 +335,11 @@ class RpcServer:
             for sock in ready:
                 if sock is self._listener:
                     conn, _ = self._listener.accept()
+                    if self.family == "tcp":
+                        # one small frame each way per call: Nagle's ACK
+                        # delay would serialize every router step on it
+                        conn.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
                     self._clients.append(conn)
                     continue
                 if not self._dispatch(sock):
@@ -308,14 +365,15 @@ class RpcServer:
 # -- client -----------------------------------------------------------------
 
 class RpcClient:
-    """Unix-socket RPC client with per-call deadlines, bounded-backoff
-    reconnect, per-method call clocks (the transport fault sites key on
-    them), and host-side transport stats."""
+    """RPC client (unix path or ``tcp://host:port``) with per-call
+    deadlines, bounded-backoff reconnect, per-method call clocks (the
+    transport fault sites key on them), and host-side transport stats."""
 
-    def __init__(self, path: str, *,
+    def __init__(self, path, *,
                  transport: RouterTransportConfig | None = None,
                  fault_injection=None, seed: int = 0, telemetry=None):
-        self.path = str(path)
+        self._family, self._target = parse_address(path)
+        self.path = format_address(self._family, self._target)
         self.transport = transport or RouterTransportConfig()
         self._reconnect_policy = RetryPolicy(
             max_attempts=int(self.transport.connect_attempts),
@@ -365,14 +423,18 @@ class RpcClient:
         for attempt in range(1, max(1, p.max_attempts) + 1):
             if attempt > 1:
                 time.sleep(backoff_delay(attempt - 1, p, seed=self._seed))
-            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            family = (socket.AF_INET if self._family == "tcp"
+                      else socket.AF_UNIX)
+            s = socket.socket(family, socket.SOCK_STREAM)
             s.settimeout(max(0.05, float(self.transport.call_timeout_s)))
             try:
-                s.connect(self.path)
+                s.connect(self._target)
             except OSError as e:
                 last = e
                 s.close()
                 continue
+            if self._family == "tcp":
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
             if self._ever_connected:
                 self._count("reconnects")
@@ -382,8 +444,19 @@ class RpcClient:
             f"connect to {self.path} failed after {p.max_attempts} "
             f"attempts: {last}")
 
-    def _drop(self) -> None:
+    def _drop(self, rst: bool = False) -> None:
         if self._sock is not None:
+            if rst and self._family == "tcp":
+                # the TCP flavor of the injected conn-reset site: linger-0
+                # close sends a genuine RST, so the remote sees the abortive
+                # reset a yanked cable / kill -9 host produces — not a
+                # graceful FIN half-close
+                try:
+                    self._sock.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+                except OSError:
+                    pass
             try:
                 self._sock.close()
             except OSError:
@@ -448,7 +521,7 @@ class RpcClient:
             if self._inj.rpc_conn_reset(method, n):
                 self._count("conn_resets")
                 self._count("injected_faults")
-                self._drop()
+                self._drop(rst=True)  # tcp: abortive RST, not graceful FIN
                 raise RpcConnectionLost(
                     f"fault injection: rpc_conn_reset on {method} #{n}")
             if self._inj.rpc_timeout(method, n):
@@ -717,5 +790,6 @@ __all__ = [
     "RpcError", "RpcTimeout", "RpcConnectionLost", "RpcGarbledFrame",
     "RpcRemoteError",
     "encode_request", "decode_request", "encode_result", "decode_result",
+    "parse_address", "format_address",
     "recv_frame", "send_frame",
 ]

@@ -132,7 +132,11 @@ class Request:
     engine's ``default_deadline_s``, which may itself be 0 = none) bounds
     the request's total latency: past it a queued request is shed
     (``expired``) and an in-flight one is cancelled/evicted
-    (``deadline_exceeded``) with whatever it produced so far."""
+    (``deadline_exceeded``) with whatever it produced so far. ``priority``
+    orders overload shedding only (higher = kept longer): when a browned-
+    out Router's global queue bound is hit, the lowest-priority newest
+    queued request is shed first (docs/serving.md "Elastic fleet &
+    brownout"); it never affects admission or decode order."""
 
     uid: int
     prompt: np.ndarray  # [S] int32
@@ -143,6 +147,7 @@ class Request:
     eos_token: Optional[int] = None
     arrival_time: float = 0.0
     deadline_s: float = 0.0
+    priority: int = 0
 
 
 @dataclass

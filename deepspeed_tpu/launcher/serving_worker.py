@@ -8,6 +8,15 @@ serves the scheduler surface over ``inference/rpc.RpcServer``. The Router
 drives it through ``rpc.ReplicaClient`` exactly as it drives an in-process
 replica.
 
+``--socket`` takes a unix socket path (same-host fleets) or
+``tcp://host:port`` (replicas on separate hosts; port 0 binds an
+ephemeral port and the resolved address rides the ``ready`` line, which
+is how the supervisor discovers it). Per-worker device/platform
+assignment: ``--platform`` pins ``JAX_PLATFORMS`` for THIS process before
+jax loads, and the supervisor's ``worker_env`` injects arbitrary
+per-slot environment (e.g. ``TPU_VISIBLE_CHIPS`` / mesh selection), so
+each replica of a fleet can own a different device set or mesh.
+
 Process lifecycle:
 
   * heartbeat — when ``--heartbeat FILE`` is given the worker touches it on
@@ -249,14 +258,31 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.launcher.serving_worker",
         description="Host one ServingEngine replica behind the serving RPC.")
-    ap.add_argument("--socket", required=True, help="unix socket path to bind")
+    ap.add_argument("--socket", required=True,
+                    help="address to bind: a unix socket path, or "
+                         "tcp://host:port (port 0 = OS-assigned; the "
+                         "resolved address is printed in the ready line)")
     ap.add_argument("--spec", required=True,
                     help="JSON spec file: {model, engine_dtype, serving}")
     ap.add_argument("--replica-id", default="0",
                     help="identity stamped into telemetry snapshots")
     ap.add_argument("--heartbeat", default="",
                     help="heartbeat file touched each serve-loop tick")
+    ap.add_argument("--platform", default="",
+                    help="pin the jax platform for this worker (per-worker "
+                         "device/platform assignment)")
     args = ap.parse_args(argv)
+
+    if args.platform:
+        # jax is ALREADY imported (the package __init__ pulls it), so the
+        # env var alone is too late — jax.config.update is the mechanism
+        # that works post-import (and the only one the axon site hook
+        # honors; utils/jax_env.py documents the incident). The env var is
+        # still set for anything this worker spawns.
+        os.environ["JAX_PLATFORMS"] = args.platform
+        from ..utils.jax_env import apply_platform_env
+
+        apply_platform_env()
 
     with open(args.spec) as f:
         spec = json.load(f)
@@ -271,8 +297,11 @@ def main(argv=None) -> int:
     engine = build_serving_engine(spec, replica_id=rid)
     host = WorkerHost(engine, heartbeat=args.heartbeat or None)
     server = RpcServer(args.socket, host.handlers())
+    # the RESOLVED address (a tcp://host:0 bind reports its real port):
+    # the supervisor reads this line to learn where to connect
     print(json.dumps({"event": "ready", "pid": os.getpid(),
-                      "replica_id": rid, "socket": args.socket}), flush=True)
+                      "replica_id": rid, "socket": server.address}),
+          flush=True)
     try:
         server.serve_forever(should_stop=guard.pending, on_tick=host.tick)
     finally:
@@ -294,21 +323,39 @@ class WorkerSupervisor:
     """Spawn/respawn serving worker processes — the elastic agent's
     heartbeat-timeout/SIGKILL discipline applied to the serving fleet.
 
-    One replica SLOT per worker (slot ids 0..n-1); each (re)spawn is a new
-    generation with a fresh socket path. ``poll()`` detects exited workers
-    and SIGKILLs hung ones (heartbeat stale on a monotonic clock);
-    ``respawn()`` pays the bounded-backoff delay and boots a replacement.
-    The caller wires respawned clients back into a Router via
+    One replica SLOT per worker; each (re)spawn is a new generation with a
+    fresh address (unix socket path, or ``transport.host:port_base+slot``
+    / an OS-assigned ephemeral port under the TCP family). ``poll()``
+    detects exited workers and SIGKILLs hung ones (heartbeat stale on a
+    monotonic clock); ``respawn()`` pays the bounded-backoff delay and
+    boots a replacement. The caller (usually ``inference/autoscaler.
+    Autoscaler``) wires respawned clients back into a Router via
     ``Router.attach_replica`` — a replacement process is a NEW replica,
-    never a resurrection of the dead rid."""
+    never a resurrection of the dead rid.
+
+    Respawn-budget healing: ``_respawn_count[slot]`` decays by one for
+    every ``respawn_heal_s`` of heartbeat-healthy uptime the slot's
+    current generation accrues, so a long-lived fleet with occasional
+    preemptions is never one respawn from permanent ``max_respawns``
+    exhaustion. Crash-loop detection is unchanged — rapid deaths never
+    live long enough to heal and still exhaust the budget.
+
+    ``worker_env`` maps slot -> extra environment for THAT worker only
+    (on top of the fleet-wide ``env``) — per-worker device/platform
+    assignment: e.g. ``{0: {"JAX_PLATFORMS": "tpu",
+    "TPU_VISIBLE_CHIPS": "0"}, 1: {"TPU_VISIBLE_CHIPS": "1"}}`` puts each
+    replica on its own chip set / mesh."""
 
     def __init__(self, spec: dict, n_workers: int, *,
                  workdir: Optional[str] = None,
                  transport: RouterTransportConfig | dict | None = None,
                  respawn_backoff: RetryPolicy | dict | None = None,
                  max_respawns: int = 3,
+                 respawn_heal_s: float = 300.0,
                  seed: int = 0,
-                 env: Optional[dict] = None):
+                 env: Optional[dict] = None,
+                 worker_env: Optional[dict] = None,
+                 clock=None):
         if isinstance(transport, dict):
             transport = RouterTransportConfig(**transport)
         self.transport = transport or RouterTransportConfig()
@@ -318,8 +365,12 @@ class WorkerSupervisor:
             max_attempts=1 << 30, base_delay_s=0.5, max_delay_s=8.0,
             jitter=0.25)
         self.max_respawns = int(max_respawns)
+        self.respawn_heal_s = float(respawn_heal_s)
         self.seed = int(seed)
         self.n_workers = int(n_workers)
+        # verdict/heal clock: monotonic (injectable for fake-clock tests;
+        # never wall time — the PR 8 NTP lesson)
+        self._now = clock if clock is not None else time.monotonic
         # sockets live here: a caller-supplied deep path can overflow the
         # AF_UNIX sun_path limit (~108 chars), so default to a short tmpdir
         self.workdir = workdir or tempfile.mkdtemp(prefix="dstpu_srv_")
@@ -328,11 +379,14 @@ class WorkerSupervisor:
         with open(self.spec_path, "w") as f:
             json.dump(spec, f)
         self.extra_env = dict(env or {})
+        self.worker_env = {int(k): dict(v)
+                           for k, v in (worker_env or {}).items()}
         self._procs: dict[int, subprocess.Popen] = {}
         self._clients: dict[int, ReplicaClient] = {}
         self._logs: dict[int, str] = {}
         self._gen: Counter = Counter()
         self._respawn_count: Counter = Counter()
+        self._heal_anchor: dict[int, float] = {}
         # heartbeat staleness is judged by the shared monotonic judge
         # (resilience/heartbeat.HeartbeatJudge, same as the elastic
         # agent): mtime-change observations on a monotonic clock — an NTP
@@ -344,13 +398,40 @@ class WorkerSupervisor:
 
     # -- spawn -----------------------------------------------------------
 
-    def _sock_path(self, slot: int) -> str:
+    def _listen_address(self, slot: int) -> str:
+        """The address the slot's NEXT generation binds: a per-generation
+        unix socket path, or ``tcp://host:{port_base+slot}`` (port 0 under
+        an unset ``port_base`` — the worker binds an ephemeral port and
+        the supervisor learns it from the ready line)."""
+        t = self.transport
+        if t.family == "tcp":
+            port = t.port_base + slot if t.port_base else 0
+            return f"tcp://{t.host}:{port}"
         return os.path.join(self.workdir, f"w{slot}g{self._gen[slot]}.sock")
+
+    def _ready_address(self, slot: int) -> Optional[str]:
+        """The resolved address from the worker's ``ready`` log line (how
+        an ephemeral TCP port is discovered); None until printed."""
+        try:
+            with open(self._logs[slot]) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line.startswith("{"):
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if ev.get("event") == "ready":
+                        return ev.get("socket")
+        except OSError:
+            pass
+        return None
 
     def spawn(self, slot: int) -> ReplicaClient:
         """Boot the worker for ``slot`` and block until its socket serves a
         ping (bounded by ``transport.boot_timeout_s``)."""
-        sock = self._sock_path(slot)
+        addr = self._listen_address(slot)
         hb = os.path.join(self.workdir, f"hb{slot}")
         with open(hb, "w"):
             pass
@@ -358,43 +439,54 @@ class WorkerSupervisor:
         judge = HeartbeatJudge(hb, float(self.transport.heartbeat_timeout_s))
         judge.reset()
         self._hb_judge[slot] = judge
+        self._heal_anchor[slot] = self._now()
         log_path = os.path.join(self.workdir,
                                 f"w{slot}g{self._gen[slot]}.log")
         self._logs[slot] = log_path
         env = dict(os.environ)
         env.update(self.extra_env)
+        env.update(self.worker_env.get(slot, {}))
         cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.serving_worker",
-               "--socket", sock, "--spec", self.spec_path,
+               "--socket", addr, "--spec", self.spec_path,
                "--replica-id", str(slot), "--heartbeat", hb]
         with open(log_path, "w") as log_f:
             proc = subprocess.Popen(cmd, env=env, stdout=log_f,
                                     stderr=subprocess.STDOUT,
                                     start_new_session=True)
         self._procs[slot] = proc
-        client = ReplicaClient(sock, replica_id=slot,
-                               transport=self.transport,
-                               seed=self.seed * 1009 + slot)
+        # an ephemeral-port worker resolves its address at bind time; poll
+        # the ready line for it before the first connect
+        ephemeral = addr.startswith("tcp://") and addr.endswith(":0")
+        client: Optional[ReplicaClient] = None
         deadline = time.monotonic() + float(self.transport.boot_timeout_s)
         while True:
             if proc.poll() is not None:
                 raise RuntimeError(
                     f"serving worker slot {slot} exited rc={proc.returncode} "
                     f"during boot (log: {log_path}): {self.log_tail(slot)}")
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise RuntimeError(
+                    f"serving worker slot {slot} did not serve within "
+                    f"boot_timeout_s={self.transport.boot_timeout_s} "
+                    f"(log: {log_path})")
+            if client is None:
+                target = self._ready_address(slot) if ephemeral else addr
+                if target is None:
+                    time.sleep(0.1)
+                    continue
+                client = ReplicaClient(target, replica_id=slot,
+                                       transport=self.transport,
+                                       seed=self.seed * 1009 + slot)
             try:
                 client.connect()
                 client.ping()
                 break
             except RpcConnectionLost:
-                if time.monotonic() > deadline:
-                    proc.kill()
-                    raise RuntimeError(
-                        f"serving worker slot {slot} did not serve within "
-                        f"boot_timeout_s={self.transport.boot_timeout_s} "
-                        f"(log: {log_path})") from None
                 time.sleep(0.1)
         self._clients[slot] = client
-        logger.info("serving supervisor: slot %d generation %d up (pid %d)",
-                    slot, self._gen[slot], proc.pid)
+        logger.info("serving supervisor: slot %d generation %d up (pid %d, "
+                    "%s)", slot, self._gen[slot], proc.pid, client.rpc.path)
         return client
 
     def start(self) -> list[ReplicaClient]:
@@ -410,7 +502,7 @@ class WorkerSupervisor:
         try:
             with open(self._logs[slot]) as f:
                 return " | ".join(f.read().strip().splitlines()[-lines:])
-        except OSError:
+        except (KeyError, OSError):  # never-spawned slot / unreadable log
             return "<no log>"
 
     # -- liveness --------------------------------------------------------
@@ -423,7 +515,14 @@ class WorkerSupervisor:
         """One supervision pass: slots whose worker exited, plus slots
         whose heartbeat went stale (those are SIGKILL'd first — a wedged
         worker already ignored its chance to exit). Returns the slots that
-        now need ``respawn()``."""
+        now need ``respawn()``.
+
+        Healthy uptime also HEALS the respawn budget here: every
+        ``respawn_heal_s`` of alive-and-heartbeating time decays the
+        slot's ``_respawn_count`` by one, so occasional preemptions over a
+        long fleet lifetime never accumulate into ``max_respawns``
+        exhaustion. A crash-looping worker never lives that long — its
+        budget still runs out."""
         bad = []
         for slot, proc in list(self._procs.items()):
             if proc.poll() is not None:
@@ -435,6 +534,17 @@ class WorkerSupervisor:
                 proc.kill()
                 proc.wait()
                 bad.append(slot)
+            elif self.respawn_heal_s > 0 and self._respawn_count[slot] > 0:
+                anchor = self._heal_anchor.get(slot, self._now())
+                while (self._respawn_count[slot] > 0
+                       and self._now() - anchor >= self.respawn_heal_s):
+                    self._respawn_count[slot] -= 1
+                    anchor += self.respawn_heal_s
+                    logger.info(
+                        "serving supervisor: slot %d respawn budget healed "
+                        "to %d after sustained health", slot,
+                        self._respawn_count[slot])
+                self._heal_anchor[slot] = anchor
         return bad
 
     def respawn(self, slot: int) -> ReplicaClient:
@@ -465,6 +575,35 @@ class WorkerSupervisor:
     def kill(self, slot: int, sig: int = signal.SIGKILL) -> None:
         """Deliver ``sig`` to the slot's worker (the chaos drill's kill -9)."""
         os.kill(self._procs[slot].pid, sig)
+
+    def retire(self, slot: int, timeout: float = 30.0) -> None:
+        """Permanently remove ``slot`` from supervision — the autoscaler's
+        scale-down path (its replica has drained; nothing is in flight).
+        SIGTERM gives a live worker its drain-then-exit-0 path; a corpse
+        is simply reaped. The slot never appears in later ``poll()``s and
+        is never respawned (``spawn(slot)`` would start a fresh
+        generation if the fleet grows again)."""
+        proc = self._procs.pop(slot, None)
+        client = self._clients.pop(slot, None)
+        self._hb_judge.pop(slot, None)
+        self._hb_path.pop(slot, None)
+        self._heal_anchor.pop(slot, None)
+        if client is not None:
+            client.close()
+        if proc is None:
+            return
+        if proc.poll() is None:
+            try:
+                os.kill(proc.pid, signal.SIGTERM)
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+        logger.info("serving supervisor: slot %d retired (rc=%s)",
+                    slot, proc.returncode)
 
     def shutdown(self, sig: int = signal.SIGTERM, timeout: float = 10.0) -> None:
         for slot, proc in self._procs.items():

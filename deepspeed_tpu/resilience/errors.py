@@ -114,9 +114,13 @@ class RpcRemoteError(RpcError):
 class RequestRejected(ResilienceError):
     """Serving load-shed verdict: the request was refused admission instead
     of growing the arrival queue without bound. ``reason`` is a stable typed
-    string — currently always ``queue_full`` (a deadline that expires while
-    QUEUED surfaces as a result with status ``expired``, not an
-    exception)."""
+    string: ``queue_full`` (per-engine or router-global bound),
+    ``no_healthy_replicas`` (no replica accepting dispatch), or
+    ``overloaded`` — the brownout back-off hint: the fleet is at max
+    capacity, still saturated, and nothing queued was lower priority than
+    this arrival, so clients should slow down rather than retry hot.
+    (A deadline that expires while QUEUED surfaces as a result with status
+    ``expired``, not an exception.)"""
 
     def __init__(self, uid: int, reason: str, detail: str = ""):
         super().__init__(

@@ -46,7 +46,11 @@ Fault sites (see docs/resilience.md for where each is wired):
   ``rpc_conn_reset``  the connection drops after the Nth call of a method
                       executes (reply discarded, socket closed —
                       ``RpcConnectionLost``; the next call pays the
-                      bounded-backoff reconnect).
+                      bounded-backoff reconnect). Over the TCP family the
+                      client closes with SO_LINGER(0), so the peer sees a
+                      genuine RST — the abortive reset a yanked cable or a
+                      kill -9'd host produces, not a graceful FIN
+                      (inference/rpc.py ``RpcClient._drop``).
   ``rpc_garbled_frame``  the Nth reply frame of a method fails the
                       magic/CRC check (``RpcGarbledFrame``; the stream is
                       desynchronized, so the socket is closed too).

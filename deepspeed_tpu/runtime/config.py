@@ -616,6 +616,17 @@ class RouterTransportConfig:
     Governs the RPC transport when replicas are worker processes (in-process
     replicas never touch it):
 
+    - ``family``: ``unix`` (same-host socket files, the default) or ``tcp``
+      (loopback/cross-host) — the SAME DSRP crc32 frames, per-call
+      monotonic deadlines, bounded-backoff reconnect and replay-safe
+      step/withdraw discipline ride both families.
+    - ``host``: TCP bind/connect host for supervisor-spawned workers
+      (``127.0.0.1`` for same-host fleets; a routable address for
+      cross-host ones).
+    - ``port_base``: TCP listen port for worker slot ``i`` is
+      ``port_base + i``; 0 (the default) lets the OS assign an ephemeral
+      port, which the supervisor learns from the worker's ``ready`` line —
+      collision-free without coordination.
     - ``call_timeout_s``: per-call reply deadline. A ``step()`` that misses
       it surfaces as ``RpcTimeout`` — the Router's HUNG verdict (the call
       may have executed; the outcome is unknown).
@@ -631,6 +642,9 @@ class RouterTransportConfig:
       0 disables heartbeat supervision (process exit is still detected).
     """
 
+    family: str = "unix"
+    host: str = "127.0.0.1"
+    port_base: int = 0
     call_timeout_s: float = 30.0
     connect_attempts: int = 4
     base_delay_s: float = 0.2
@@ -640,6 +654,14 @@ class RouterTransportConfig:
     heartbeat_timeout_s: float = 10.0
 
     def __post_init__(self):
+        if self.family not in ("unix", "tcp"):
+            raise DeepSpeedConfigError(
+                f"serving.router.transport.family must be unix|tcp, "
+                f"got {self.family!r}")
+        if not 0 <= self.port_base <= 65535:
+            raise DeepSpeedConfigError(
+                f"serving.router.transport.port_base must be in [0, 65535], "
+                f"got {self.port_base}")
         if self.call_timeout_s <= 0:
             raise DeepSpeedConfigError(
                 f"serving.router.transport.call_timeout_s must be > 0, "
@@ -666,6 +688,96 @@ class RouterTransportConfig:
 
 
 @dataclass
+class AutoscaleConfig:
+    """``serving.router.autoscale`` block (consumed by
+    ``inference/autoscaler.Autoscaler``; docs/serving.md "Elastic fleet &
+    brownout").
+
+    Closes the loop from the fleet's own telemetry (router load, arrival
+    backlog, per-replica step latency, PR 7's MFU gauges) back to
+    ``attach_replica``/``drain_replica`` — with hysteresis so a flapping
+    metric can never oscillate the fleet:
+
+    - ``enabled``: evaluate scaling on every router step (an in-process
+      ``Router(engine, config=...)`` builds its own autoscaler; a
+      process-mode fleet wires one to a ``WorkerSupervisor``).
+    - ``min_replicas`` / ``max_replicas``: the fleet-size envelope.
+    - ``scale_up_queue``: fleet-wide queued-request backlog at/past which
+      the up-signal fires.
+    - ``scale_up_load``: mean scheduler load per HEALTHY replica
+      (queued + prefilling + decoding) at/past which the up-signal fires.
+    - ``scale_up_step_s``: last observed per-replica step latency past
+      which the up-signal fires (0 disables the latency signal).
+    - ``scale_up_mfu``: mean fleet MFU (from the program ledger's
+      ``serving/mfu`` gauges, observed through ``Router.
+      telemetry_snapshot()``) at/past which the up-signal fires — a
+      compute-saturated fleet scales out even before queues grow
+      (0 disables; unrated platforms never produce the gauge).
+    - ``scale_down_load``: mean load per healthy replica at/below which
+      (with an empty backlog) the down-signal fires; must not exceed
+      ``scale_up_load`` or flapping is guaranteed.
+    - ``up_consecutive`` / ``down_consecutive``: evaluations the signal
+      must persist before acting (the hysteresis window).
+    - ``cooldown_s``: minimum router-clock seconds between scale actions.
+    - ``brownout_deadline_s``: deadline applied to deadline-free requests
+      while the fleet is browned out (at max and still saturated);
+      0 = never tighten deadlines.
+    - ``events_capacity``: bounded ring of typed autoscale decision events
+      (rendered by the report CLI, carried in snapshots).
+    """
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_up_queue: int = 4
+    scale_up_load: float = 3.0
+    scale_up_step_s: float = 0.0
+    scale_up_mfu: float = 0.0
+    scale_down_load: float = 0.5
+    up_consecutive: int = 2
+    down_consecutive: int = 4
+    cooldown_s: float = 5.0
+    brownout_deadline_s: float = 0.0
+    events_capacity: int = 256
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise DeepSpeedConfigError(
+                f"serving.router.autoscale.min_replicas must be >= 1, "
+                f"got {self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise DeepSpeedConfigError(
+                f"serving.router.autoscale.max_replicas ({self.max_replicas}) "
+                f"must be >= min_replicas ({self.min_replicas})")
+        if self.up_consecutive < 1 or self.down_consecutive < 1:
+            raise DeepSpeedConfigError(
+                "serving.router.autoscale up/down_consecutive must be >= 1")
+        if self.cooldown_s < 0 or self.brownout_deadline_s < 0:
+            raise DeepSpeedConfigError(
+                "serving.router.autoscale cooldown_s/brownout_deadline_s "
+                "must be >= 0")
+        if (self.scale_up_queue < 0 or self.scale_up_load < 0
+                or self.scale_up_step_s < 0 or self.scale_down_load < 0
+                or not 0.0 <= self.scale_up_mfu <= 1.0):
+            raise DeepSpeedConfigError(
+                "serving.router.autoscale thresholds must be >= 0 "
+                "(scale_up_mfu in [0, 1])")
+        if 0 < self.scale_up_load < self.scale_down_load:
+            # a down threshold above the up threshold makes one load value
+            # simultaneously an up- and down-signal: guaranteed flapping
+            # (scale_up_load 0 disables the load up-signal entirely, so no
+            # flap is possible from it)
+            raise DeepSpeedConfigError(
+                f"serving.router.autoscale.scale_down_load "
+                f"({self.scale_down_load}) must be <= scale_up_load "
+                f"({self.scale_up_load})")
+        if self.events_capacity < 1:
+            raise DeepSpeedConfigError(
+                f"serving.router.autoscale.events_capacity must be >= 1, "
+                f"got {self.events_capacity}")
+
+
+@dataclass
 class RouterConfig:
     """``serving.router`` block (consumed by ``inference/router.Router``;
     docs/serving.md "Multi-replica router").
@@ -683,6 +795,8 @@ class RouterConfig:
     - ``health``: liveness/probation sub-block (its own dataclass above).
     - ``transport``: RPC transport sub-block for process-mode replicas
       (its own dataclass above; ignored by in-process fleets).
+    - ``autoscale``: ledger-driven elastic scaling sub-block (its own
+      dataclass above; docs/serving.md "Elastic fleet & brownout").
     """
 
     replicas: int = 1
@@ -691,12 +805,15 @@ class RouterConfig:
     health: RouterHealthConfig = field(default_factory=RouterHealthConfig)
     transport: RouterTransportConfig = field(
         default_factory=RouterTransportConfig)
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
 
     def __post_init__(self):
         if isinstance(self.health, dict):
             self.health = _build(RouterHealthConfig, self.health)
         if isinstance(self.transport, dict):
             self.transport = _build(RouterTransportConfig, self.transport)
+        if isinstance(self.autoscale, dict):
+            self.autoscale = _build(AutoscaleConfig, self.autoscale)
         if self.replicas < 1:
             raise DeepSpeedConfigError(
                 f"serving.router.replicas must be >= 1, got {self.replicas}")

@@ -323,6 +323,32 @@ def summarize(events: list[dict], top: int = 10) -> str:
                 f"{k}={v:g}" for k, v in sorted(cs.items())))
         lines.append("")
 
+    # -- autoscaler -----------------------------------------------------
+    # the elasticity loop's decision ring (inference/autoscaler.py):
+    # target/brownout state plus the typed scale/respawn/brownout events,
+    # so "why did the fleet grow at t=3.2s" is answerable from CI logs
+    asc = rt.get("autoscale") if rt else None
+    if asc:
+        lines.append(
+            f"autoscaler (target {asc.get('target', '?')} in "
+            f"[{asc.get('min', '?')}, {asc.get('max', '?')}], brownout "
+            f"{'ON' if asc.get('brownout') else 'off'}):")
+        asc_events = asc.get("events", [])
+        for ev in asc_events[-top:]:
+            detail = " ".join(
+                f"{k}={v}" for k, v in ev.items()
+                if k not in ("t", "kind", "signals"))
+            sig = ev.get("signals")
+            if sig:
+                detail += ("  [" + " ".join(
+                    f"{k}={v}" for k, v in sig.items()
+                    if v is not None) + "]")
+            lines.append(f"  {_fmt_s(ev.get('t', 0.0)):>10} "
+                         f"{ev.get('kind', '?'):<14} {detail}")
+        if len(asc_events) > top:
+            lines.append(f"  ... +{len(asc_events) - top} earlier events")
+        lines.append("")
+
     # -- resilience -----------------------------------------------------
     # recovery/degradation events (resilience/* counters) + injector stats,
     # rendered as their own table so a faulted run's triage starts here

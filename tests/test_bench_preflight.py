@@ -129,6 +129,34 @@ def test_forced_preflight_failure_emits_non_comparable_row(
     assert "preflight failed" in row["diagnosis"]
 
 
+def _run_bench_argv(*argv):
+    import subprocess
+    import sys
+
+    return subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py"), *argv],
+        capture_output=True, text=True, timeout=60)
+
+
+@pytest.mark.parametrize("argv", [
+    ("--surge", "-3"),            # negative operand
+    ("--surge", "abc"),           # non-numeric operand
+    ("--surge", "4"),             # below the structural minimum
+    ("--surge", "30", "--surge-seed", "xyz"),  # non-numeric seed
+    ("--surge", "30", "--surge-seed"),         # dangling seed flag
+])
+def test_surge_argv_contract_exits_2_with_usage(argv):
+    """``--surge`` follows the ``--chaos``/``--chaos-serving`` contract:
+    malformed operands exit 2 with a usage line on stderr — never a
+    traceback, never a started drill. (The check runs before any jax
+    import, so the subprocess is cheap.)"""
+    proc = _run_bench_argv(*argv)
+    assert proc.returncode == 2, (argv, proc.stderr)
+    assert "usage: bench.py --surge" in proc.stderr
+    assert "Traceback" not in proc.stderr
+
+
 def test_tpu_row_stays_comparable(bench, monkeypatch, capsys):
     monkeypatch.delenv("DSTPU_BENCH_FORCE_PREFLIGHT_FAIL", raising=False)
     monkeypatch.setenv("DSTPU_BENCH_PREFLIGHT_ATTEMPTS", "2")

@@ -47,6 +47,7 @@ def findings_for(res, rule):
 def test_registry_has_the_shipped_rules():
     expected = {"wall-clock-verdict", "broad-except", "blocking-under-lock",
                 "unguarded-donation", "rename-durability",
+                "socket-discipline",
                 "config-doc-drift", "metric-doc-drift",
                 "pragma", "parse-error"}
     assert expected <= set(RULES)
@@ -279,6 +280,80 @@ def test_donation_through_helper_and_helper_module_pass(tmp_path):
     })
     res = run_lint(pkg, rule_ids=["unguarded-donation"])
     assert not findings_for(res, "unguarded-donation")
+
+
+# ---------------------------------------------------------------------------
+# socket-discipline
+
+
+def test_socket_discipline_flags_undeadlined_io(tmp_path):
+    pkg = make_tree(tmp_path, {"inference/x.py": """\
+        import socket
+        def fetch(addr):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.connect(addr)
+            return s.recv(4)
+    """})
+    res = run_lint(pkg, rule_ids=["socket-discipline"])
+    (f,) = findings_for(res, "socket-discipline")
+    assert f.line == 3 and "connect/recv" in f.message
+    assert "settimeout" in f.message
+
+
+def test_socket_discipline_settimeout_in_scope_is_clean(tmp_path):
+    pkg = make_tree(tmp_path, {"inference/x.py": """\
+        import socket
+        def fetch(addr, budget):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.settimeout(budget)
+            s.connect(addr)
+            return s.recv(4)
+    """})
+    res = run_lint(pkg, rule_ids=["socket-discipline"])
+    assert not findings_for(res, "socket-discipline")
+
+
+def test_socket_discipline_deadline_variable_counts(tmp_path):
+    # the rpc.py idiom: the deadline is threaded, the per-recv timeout is
+    # derived from it elsewhere in the loop
+    pkg = make_tree(tmp_path, {"inference/x.py": """\
+        import socket
+        def fetch(addr, deadline):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.connect(addr)
+            while deadline > 0:
+                return s.recv(4)
+    """})
+    res = run_lint(pkg, rule_ids=["socket-discipline"])
+    assert not findings_for(res, "socket-discipline")
+
+
+def test_socket_discipline_bind_listen_only_is_clean(tmp_path):
+    # a listener construction with no blocking I/O in the same scope: the
+    # accept loop carries its own deadline where it lives (select/poll)
+    pkg = make_tree(tmp_path, {"inference/x.py": """\
+        import socket
+        def make_listener(path):
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.bind(path)
+            s.listen(8)
+            return s
+    """})
+    res = run_lint(pkg, rule_ids=["socket-discipline"])
+    assert not findings_for(res, "socket-discipline")
+
+
+def test_socket_discipline_pragma_with_rationale_suppresses(tmp_path):
+    pkg = make_tree(tmp_path, {"inference/x.py": """\
+        import socket
+        def fetch(addr):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # dstpu: allow[socket-discipline] -- interactive debug REPL helper, hang is the operator's ctrl-C
+            s.connect(addr)
+            return s.recv(4)
+    """})
+    res = run_lint(pkg, rule_ids=["socket-discipline"])
+    assert not findings_for(res, "socket-discipline")
+    assert len(res.suppressed) == 1
 
 
 # ---------------------------------------------------------------------------

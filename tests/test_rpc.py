@@ -38,6 +38,36 @@ from deepspeed_tpu.runtime.config import RouterTransportConfig
 TRANSPORT = dict(call_timeout_s=60.0, connect_attempts=2,
                  base_delay_s=0.05, max_delay_s=0.1, jitter=0.0)
 
+# the replay-safety / garble-detection / kill-failover proofs run over
+# BOTH address families: the TCP transport must honor the exact same
+# frame + verdict contract as the PR 8 unix sockets
+FAMILIES = ["unix", "tcp"]
+
+
+def _sock_pair(family):
+    """A connected stream pair of the given family (socketpair is always
+    AF_UNIX; TCP builds a real loopback connection)."""
+    if family == "unix":
+        return socket.socketpair()
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.settimeout(5.0)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    a = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    a.settimeout(5.0)
+    a.connect(lst.getsockname())
+    b, _ = lst.accept()
+    b.settimeout(5.0)
+    lst.close()
+    return a, b
+
+
+def _worker_addr(tmp_path, name, family):
+    """The bind address a thread-hosted worker uses for ``family``."""
+    if family == "tcp":
+        return "tcp://127.0.0.1:0"  # ephemeral; RpcServer reports it
+    return os.path.join(str(tmp_path), f"{name}.sock")
+
 
 # ---------------------------------------------------------------- frames
 
@@ -56,9 +86,10 @@ def test_frame_roundtrip_numpy_and_nesting():
         b.close()
 
 
-def test_frame_garble_truncation_and_deadline():
+@pytest.mark.parametrize("family", FAMILIES)
+def test_frame_garble_truncation_and_deadline(family):
     # bad magic
-    a, b = socket.socketpair()
+    a, b = _sock_pair(family)
     try:
         a.sendall(b"XXXX" + struct.pack("!II", 2, 0) + b"{}")
         with pytest.raises(RpcGarbledFrame, match="bad frame header"):
@@ -67,7 +98,7 @@ def test_frame_garble_truncation_and_deadline():
         a.close()
         b.close()
     # crc mismatch (one payload byte flipped after the header was built)
-    a, b = socket.socketpair()
+    a, b = _sock_pair(family)
     try:
         payload = b'{"x":1}'
         a.sendall(b"DSRP" + struct.pack(
@@ -78,7 +109,7 @@ def test_frame_garble_truncation_and_deadline():
         a.close()
         b.close()
     # peer closes mid-frame
-    a, b = socket.socketpair()
+    a, b = _sock_pair(family)
     try:
         payload = b'{"x":1}'
         a.sendall(b"DSRP" + struct.pack(
@@ -89,7 +120,7 @@ def test_frame_garble_truncation_and_deadline():
     finally:
         b.close()
     # nothing arrives inside the deadline
-    a, b = socket.socketpair()
+    a, b = _sock_pair(family)
     try:
         with pytest.raises(RpcTimeout):
             recv_frame(b, timeout=0.05)
@@ -211,7 +242,8 @@ class _ThreadWorker:
     process boot. ``stop()`` is the SIGKILL stand-in: the listener and
     streams close, and the next client call sees RpcConnectionLost."""
 
-    def __init__(self, engine, tmp_path, name, config=None, replica_id=0):
+    def __init__(self, engine, tmp_path, name, config=None, replica_id=0,
+                 family="unix"):
         from deepspeed_tpu.inference.serving import ServingEngine
         from deepspeed_tpu.launcher.serving_worker import WorkerHost
 
@@ -219,8 +251,10 @@ class _ThreadWorker:
                **(config or {})}
         self.engine = ServingEngine(engine, config=cfg, replica_id=replica_id)
         self.host = WorkerHost(self.engine)
-        self.path = os.path.join(str(tmp_path), f"{name}.sock")
-        self.server = RpcServer(self.path, self.host.handlers())
+        self.server = RpcServer(_worker_addr(tmp_path, name, family),
+                                self.host.handlers())
+        # the RESOLVED address (a tcp://...:0 bind reports its real port)
+        self.path = self.server.address
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self.server.serve_forever,
@@ -280,17 +314,20 @@ def test_replica_client_matches_inprocess_engine(tiny_serving_engine, tmp_path):
         w.stop()
 
 
+@pytest.mark.parametrize("family", FAMILIES)
 def test_step_reply_loss_recovered_by_replay_safe_retry(tiny_serving_engine,
-                                                        tmp_path):
+                                                        tmp_path, family):
     """A step reply lost to a conn reset or a garbled frame is re-delivered
     after the transparent reconnect+retry: terminal uids accumulate unacked
-    on the worker, so nothing is dropped and nothing is double-recorded."""
+    on the worker, so nothing is dropped and nothing is double-recorded.
+    Proven over BOTH address families — the TCP variant's injected reset is
+    a genuine linger-0 RST."""
     from deepspeed_tpu.inference.serving import Request
 
     prompts = _prompts([5, 11], seed=5)
     refs = [tiny_serving_engine.generate(p[None], max_new_tokens=8)[0]
             for p in prompts]
-    w = _ThreadWorker(tiny_serving_engine, tmp_path, "retry")
+    w = _ThreadWorker(tiny_serving_engine, tmp_path, "retry", family=family)
     try:
         client = w.client(fault_injection={
             "enabled": True, "seed": 0,
@@ -313,13 +350,16 @@ def test_step_reply_loss_recovered_by_replay_safe_retry(tiny_serving_engine,
         w.stop()
 
 
-def test_router_remote_kill_dead_failover_parity(tiny_serving_engine, tmp_path):
+@pytest.mark.parametrize("family", FAMILIES)
+def test_router_remote_kill_dead_failover_parity(tiny_serving_engine,
+                                                 tmp_path, family):
     """A mixed fleet (one remote replica, one in-process) — the Router
     cannot tell them apart. Killing the remote's transport mid-decode draws
     the DEAD verdict; its requests fail over from ROUTER-side state (the
     worker can't be asked), complete with solo-generate parity, and the
     merged snapshot still shows the dead replica's timeline from the
-    piggybacked trace mirror."""
+    piggybacked trace mirror. Both address families: a vanished TCP
+    listener must earn the same verdict as a vanished unix socket."""
     from deepspeed_tpu.inference.serving import Request, ServingEngine
     from deepspeed_tpu.inference import Router
     from deepspeed_tpu.telemetry import request_timeline
@@ -327,7 +367,8 @@ def test_router_remote_kill_dead_failover_parity(tiny_serving_engine, tmp_path):
     prompts = _prompts([5, 11, 23])
     refs = [tiny_serving_engine.generate(p[None], max_new_tokens=8)[0]
             for p in prompts]
-    w = _ThreadWorker(tiny_serving_engine, tmp_path, "kill", replica_id=0)
+    w = _ThreadWorker(tiny_serving_engine, tmp_path, "kill", replica_id=0,
+                      family=family)
     try:
         client = w.client(replica_id=0)
         local = ServingEngine(tiny_serving_engine, n_slots=2, max_seq_len=128,
