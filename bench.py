@@ -239,6 +239,19 @@ def main():
         if step_row is not None:
             out["mfu"] = step_row.get("mfu")
             out["roofline"] = step_row.get("roofline")
+        # collective X-ray stamp (telemetry/collective_ledger.py): the
+        # train step's comm-by-axis split, exposed-comm estimate and the
+        # STATIC overlap verdict from the compiled HLO — on CPU fallback
+        # the times stay labeled nulls (comm_rated false), never fabricated
+        anat = next((r for r in snap.get("step_anatomy", [])
+                     if r.get("name", "").startswith("train/train_step")),
+                    None)
+        if anat is not None:
+            out["step_anatomy"] = {
+                k: anat.get(k) for k in
+                ("name", "comm_bytes_by_axis", "comm_time_by_axis",
+                 "comm_time_s", "exposed_comm_estimate_s",
+                 "overlap_verdict", "comm_rated")}
         hbm = snap.get("hbm", {})
         if hbm.get("pools"):
             out["hbm_pools_bytes"] = hbm["pools"]
@@ -321,6 +334,7 @@ def _fault_smoke(rate: float) -> int:
         "comparable": False,
         "mfu": None,
         "roofline": "unrated:cpu",
+        "step_anatomy": None,
         "fault_rate": rate,
         "n_requests": len(reqs),
         "statuses": dict(statuses),
@@ -462,6 +476,7 @@ def _chaos(steps: int, seed: int) -> int:
         "comparable": False,
         "mfu": None,
         "roofline": "unrated:cpu",
+        "step_anatomy": None,
         "target_steps": steps,
         "survivor_steps": survivor_steps,
         "generations": generations,
@@ -673,6 +688,7 @@ def _chaos_serving(seed: int) -> int:
             "comparable": False,
             "mfu": None,
             "roofline": "unrated:cpu",
+            "step_anatomy": None,
             "workers": 3,
             "kills": {"mid_prefill_rid": victim_prefill,
                       "mid_decode_rid": victim_decode},
@@ -895,6 +911,7 @@ def _surge(n_requests: int, seed: int) -> int:
             "comparable": False,
             "mfu": None,
             "roofline": "unrated:cpu",
+            "step_anatomy": None,
             "n_requests": len(prompts),
             "accepted": len(submitted),
             "rejected_at_submit": dict(
@@ -919,16 +936,18 @@ def _stamp_row(obj, stage):
     (CPU), so the BENCH trajectory tooling can exclude it instead of
     silently flatlining on it (the r04/r05 regression). Rows that never ran
     anywhere (total failure) stamp platform "none". The same discipline
-    extends to the perf-xray fields: every row carries ``mfu`` and
-    ``roofline`` keys — null / "unrated:<platform>" unless the child
-    computed real ones from the program ledger, so a fallback row is
-    labeled, never rated against a TPU peak."""
+    extends to the perf-xray fields: every row carries ``mfu``,
+    ``roofline`` AND ``step_anatomy`` keys — null / "unrated:<platform>"
+    unless the child computed real ones from the program ledger /
+    collective X-ray, so a fallback row is labeled, never rated against a
+    TPU peak (and never carries fabricated comm numbers)."""
     obj["bench_stage"] = stage
     platform = obj.get("platform") or "none"
     obj["platform"] = platform
     obj["comparable"] = platform not in ("none", "cpu")
     obj.setdefault("mfu", None)
     obj.setdefault("roofline", f"unrated:{platform}")
+    obj.setdefault("step_anatomy", None)
     return obj
 
 

@@ -322,6 +322,59 @@ def check_socket_discipline(pf: PyFile) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# unlogged-collective — PR 12: a bare lax collective bypasses the comm/
+# byte accounting the collective X-ray reconciles against
+
+
+_COLLECTIVE_FNS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter",
+    "all_gather", "all_to_all", "ppermute",
+})
+_COLLECTIVE_HOME = "comm/collectives.py"
+
+
+@rule("unlogged-collective",
+      "direct lax.psum/pmean/pmax/pmin/psum_scatter/all_gather/all_to_all/"
+      "ppermute calls outside comm/collectives.py bypass the _log byte "
+      "accounting the collective X-ray cross-checks — route through the "
+      "comm/ wrappers, or pragma a zero-byte/size-probe site")
+def check_unlogged_collective(pf: PyFile) -> list[Finding]:
+    if pf.rel.replace("\\", "/").endswith(_COLLECTIVE_HOME):
+        return []  # the wrappers' own lax calls are the sanctioned sites
+    # names bound by `from jax.lax import psum [as p]` flag as bare calls
+    bare: dict[str, str] = {}
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax.lax":
+            for a in node.names:
+                if a.name in _COLLECTIVE_FNS:
+                    bare[a.asname or a.name] = a.name
+    out = []
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = None
+        if (isinstance(f, ast.Attribute) and f.attr in _COLLECTIVE_FNS):
+            # lax.psum(...) / jax.lax.psum(...) — the module spelling
+            mod = f.value
+            mod_name = (mod.id if isinstance(mod, ast.Name)
+                        else mod.attr if isinstance(mod, ast.Attribute)
+                        else None)
+            if mod_name == "lax":
+                hit = f.attr
+        elif isinstance(f, ast.Name) and f.id in bare:
+            hit = bare[f.id]
+        if hit is not None:
+            out.append(Finding(
+                "unlogged-collective", pf.rel, node.lineno,
+                f"bare lax.{hit}(...) outside comm/collectives.py — the "
+                f"comm byte accounting (and the X-ray reconcile) never "
+                f"sees it; call the comm/ wrapper, or pragma with why the "
+                f"bytes don't matter"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # rename-durability — PR 4 round 3: a rename that commits state must be
 # fsync-disciplined or a crash can surface a half-visible checkpoint
 

@@ -196,10 +196,10 @@ def all_gather(x, axis, gather_dimension: int = 0, tiled: bool = True):
     return lax.all_gather(x, axis, axis=gather_dimension, tiled=tiled)
 
 
-def all_to_all(x, axis, split_axis: int, concat_axis: int):
+def all_to_all(x, axis, split_axis: int, concat_axis: int, tiled: bool = True):
     """lax.all_to_all — MoE dispatch (reference moe/sharded_moe.py:89 _AllToAll)."""
     _log("all_to_all", axis, x)
-    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
 
 
 def ppermute(x, axis, perm):

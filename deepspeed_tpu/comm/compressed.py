@@ -22,7 +22,8 @@ from typing import Sequence, Union
 
 import jax
 import jax.numpy as jnp
-from jax import lax
+
+from .collectives import all_gather, all_to_all
 
 Axes = Union[str, Sequence[str]]
 
@@ -54,8 +55,10 @@ def compressed_allreduce_p(tensor: jax.Array, error: jax.Array, axes: Axes):
     n = comp.size
     scale = jnp.sum(jnp.abs(comp)) / n
     packed = pack_signs(comp)  # the 1-bit wire: ceil(n/8) uint8 bytes
-    gathered = lax.all_gather(packed, axes)  # [world, n/8] uint8 on the wire
-    scales = lax.all_gather(scale, axes)  # [world] fp32 (4 bytes/rank)
+    # .collectives wrappers so the 1-bit wire lands in the comm byte
+    # accounting (the saving the ROADMAP's comm counters measure)
+    gathered = all_gather(packed, axes, tiled=False)  # [world, n/8] uint8 on the wire
+    scales = all_gather(scale, axes, tiled=False)  # [world] fp32 (4 bytes/rank)
     signs = unpack_signs(gathered, n)  # [world, n] ±1, decompressed locally
     avg = jnp.mean(scales[:, None] * signs, axis=0).reshape(comp.shape)
     # error feedback compensates the payload as TRANSMITTED (scale * ±1 from
@@ -106,9 +109,9 @@ def compressed_allreduce_2phase_p(tensor: jax.Array, worker_error: jax.Array,
     new_worker_error = (comp - transmitted).reshape(shape)
     # server j gets every rank's packed chunk j: all_to_all over the chunk dim
     packed_chunks = packed.reshape(world, chunk // 8)
-    recv = lax.all_to_all(packed_chunks, axes, split_axis=0, concat_axis=0,
-                          tiled=False)  # [world, chunk/8]: rank r's chunk j=self
-    scales = lax.all_gather(w_scale, axes)  # [world] fp32
+    recv = all_to_all(packed_chunks, axes, split_axis=0, concat_axis=0,
+                      tiled=False)  # [world, chunk/8]: rank r's chunk j=self
+    scales = all_gather(w_scale, axes, tiled=False)  # [world] fp32
     # ---- phase 2: server average + re-compression ------------------------
     signs = unpack_signs(recv, chunk)  # [world, chunk]
     avg_chunk = jnp.mean(scales[:, None] * signs, axis=0)  # [chunk]
@@ -117,8 +120,8 @@ def compressed_allreduce_2phase_p(tensor: jax.Array, worker_error: jax.Array,
     packed_s = pack_signs(comp_s)  # [chunk/8]
     transmitted_s = s_scale * unpack_signs(packed_s, chunk)
     new_server_error = comp_s - transmitted_s
-    gathered = lax.all_gather(packed_s, axes)  # [world, chunk/8]
-    s_scales = lax.all_gather(s_scale, axes)  # [world]
+    gathered = all_gather(packed_s, axes, tiled=False)  # [world, chunk/8]
+    s_scales = all_gather(s_scale, axes, tiled=False)  # [world]
     out = (s_scales[:, None] * unpack_signs(gathered, chunk)).reshape(shape)
     return out, new_worker_error, new_server_error
 

@@ -21,9 +21,29 @@ def _nbytes(tensor) -> int:
     try:
         size = int(np.prod(tensor.shape))
         return size * tensor.dtype.itemsize
-    # dstpu: allow[broad-except] -- duck-typed byte probe over arbitrary "tensor" objects (tracers, shape structs, user types); 0 bytes is the documented fallback and comm logging must never fail a collective
+    # dstpu: allow[broad-except] -- duck-typed byte probe over arbitrary "tensor" objects (tracers, shape structs, pytrees, user types); the pytree walk below and then 0 bytes are the documented fallbacks, and comm logging must never fail a collective
     except Exception:
-        return 0
+        # pytrees (a whole-grad psum) sum over their array leaves
+        try:
+            import jax
+
+            return sum(
+                int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+                for leaf in jax.tree.leaves(tensor)
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype"))
+        # dstpu: allow[broad-except] -- same contract as above: the probe must never fail the collective it describes
+        except Exception:
+            return 0
+
+
+def _axis_label(axis) -> str:
+    """One canonical spelling for an axis spec: ``"data"`` stays itself, a
+    tuple/list like ``("data", "fsdp")`` becomes ``"data+fsdp"`` — the SAME
+    label the HLO-derived collective ledger uses, so the two accountings
+    reconcile key-for-key."""
+    if isinstance(axis, (tuple, list)):
+        return "+".join(str(a) for a in axis)
+    return str(axis)
 
 
 class CommsLogger:
@@ -39,7 +59,7 @@ class CommsLogger:
     def record(self, op: str, axis, tensor) -> None:
         if not self.enabled:
             return
-        key = f"{op}@{axis}"
+        key = f"{op}@{_axis_label(axis)}"
         nbytes = _nbytes(tensor)
         entry = self._ops[key]
         entry["count"] += 1
@@ -65,12 +85,93 @@ class CommsLogger:
         return self._ops
 
     def summary(self) -> dict[str, dict]:
-        """Per-op trace-time totals: {"op@axis": {"count": n, "bytes": b}}."""
-        return {k: dict(v) for k, v in sorted(self._ops.items())}
+        """Per-op trace-time totals — ``{"op@axis": {"count": n, "bytes":
+        b}}`` — plus a ``"by_axis"`` roll-up (``{axis: {count, bytes}}``,
+        distinguishable from the op entries by the absent ``@``): the
+        host-side half of the HLO cross-check (``reconcile``)."""
+        out = {k: dict(v) for k, v in sorted(self._ops.items())}
+        if out:  # an empty logger stays {} (the documented reset contract)
+            out["by_axis"] = self.axis_totals()
+        return out
+
+    def axis_totals(self) -> dict[str, dict]:
+        """Per-AXIS byte/count totals across every op family."""
+        out: dict[str, dict] = {}
+        for key, ent in self._ops.items():
+            axis = key.split("@", 1)[1] if "@" in key else key
+            agg = out.setdefault(axis, {"count": 0, "bytes": 0})
+            agg["count"] += ent["count"]
+            agg["bytes"] += ent["bytes"]
+        return {k: out[k] for k in sorted(out)}
+
+    def reconcile(self, hlo_by_axis: dict[str, dict],
+                  mesh_shape: dict | None = None) -> list[dict]:
+        """Cross-check this logger's per-axis totals against the HLO-derived
+        counts (``telemetry/collective_ledger.CollectiveLedger
+        .bytes_by_axis``). An axis present in the compiled programs but
+        absent here is either a collective that bypassed the ``comm/``
+        wrappers' ``_log`` accounting (the ``unlogged-collective`` lint
+        rule's runtime twin) or a GSPMD-implicit collective the partitioner
+        inserted with no host call site (the default engine's dp grad
+        reduction) — both worth surfacing; the reverse usually means the
+        logged program was never resolved by the ledger. Counts/bytes are NOT required to match
+        exactly — a collective inside a scan body appears once in HLO but
+        logs per trace, and XLA fuses/splits ops — so equality is reported,
+        not enforced. Each row: {axis, host_count, host_bytes, hlo_count,
+        hlo_bytes, verdict} with verdict ``ok`` | ``unlogged-in-host`` |
+        ``unseen-in-hlo``.
+
+        ``mesh_shape`` (axis -> size) canonicalizes host labels before
+        comparison: size-1 axes are dropped from tuple labels — the engine
+        logs its dp reduce over ``('data', 'fsdp')`` but on a
+        ``{data:8, fsdp:1}`` mesh the HLO groups are indistinguishable
+        from plain ``data``, and without the drop every snapshot would
+        carry a false warning pair. A host entry whose axes are ALL
+        size-1 is skipped entirely (a collective over a trivial axis is
+        identity — XLA emits nothing to reconcile against)."""
+        host = self.axis_totals()
+        if mesh_shape:
+            norm: dict[str, dict] = {}
+            for axis, ent in host.items():
+                parts = set(axis.split("+"))
+                if parts <= set(mesh_shape):
+                    # drop size-1 axes AND re-order to MESH order — the
+                    # HLO-side labels join in mesh order, and a caller
+                    # passing ('fsdp','data') means the same collective
+                    kept = [n for n in mesh_shape
+                            if n in parts and int(mesh_shape[n]) > 1]
+                    if not kept:
+                        continue  # fully trivial axis: no wire traffic
+                    axis = "+".join(kept)
+                agg = norm.setdefault(axis, {"count": 0, "bytes": 0})
+                agg["count"] += ent["count"]
+                agg["bytes"] += ent["bytes"]
+            host = norm
+        rows = []
+        for axis in sorted(set(host) | set(hlo_by_axis)):
+            h = host.get(axis)
+            x = hlo_by_axis.get(axis)
+            if h is None:
+                verdict = "unlogged-in-host"
+            elif x is None:
+                verdict = "unseen-in-hlo"
+            else:
+                verdict = "ok"
+            rows.append({
+                "axis": axis,
+                "host_count": h["count"] if h else 0,
+                "host_bytes": h["bytes"] if h else 0,
+                "hlo_count": x["count"] if x else 0,
+                "hlo_bytes": x["bytes"] if x else 0,
+                "verdict": verdict,
+            })
+        return rows
 
     def log_all(self) -> None:
         logger.info("collective trace summary (per-compile counts):")
         for key, entry in self.summary().items():
+            if key == "by_axis":  # the roll-up, not an op entry
+                continue
             logger.info(f"  {key}: count={entry['count']} volume={entry['bytes'] / 1e6:.2f} MB")
 
     def reset(self) -> None:

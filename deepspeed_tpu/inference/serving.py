@@ -673,6 +673,8 @@ class ServingEngine:
             jsonl_path=config.get("jsonl_path", ""),
             watchdog_mode=config.get("watchdog_mode", "warn"),
             ledger=lc.enabled,
+            ledger_collectives=lc.collectives.enabled,
+            ici_gbps=lc.collectives.ici_gbps,
         )
         # program-ledger join rules (telemetry/program_ledger.py): each
         # program family reads its measured wall time from its existing
@@ -685,6 +687,9 @@ class ServingEngine:
             "serving/prefill[", wall_hist="serving/prefill_sec")
         self.telemetry.ledger.bind(
             "serving/chunk_prefill[", wall_hist="serving/chunk_prefill_sec")
+        # collective X-ray axis mapping reads the inference mesh (a 1-device
+        # mesh simply yields no collectives — anatomy rows stay labeled)
+        self.telemetry.ledger.set_mesh_shape(dict(engine.mesh.shape))
         pc = prefix_cache if prefix_cache is not None else config.get("prefix_cache", {})
         if isinstance(pc, dict):
             pc = PrefixCacheConfig(**pc)

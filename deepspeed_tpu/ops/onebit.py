@@ -28,7 +28,8 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-from jax import lax
+
+from ..comm.collectives import all_reduce
 
 PyTree = Any
 
@@ -88,7 +89,7 @@ def momentum_sync(g_local, m, v, error_local, cfg: OneBitAdamConfig, dp_axes,
     if not frozen:
 
         def leaf(g, m, v, err):
-            g_avg = lax.pmean(g, dp_axes)
+            g_avg = all_reduce(g, dp_axes, op="mean")  # logged warmup comm
             return (
                 b1 * m + (1.0 - b1) * g_avg,
                 b2 * v + (1.0 - b2) * g_avg * g_avg,

@@ -32,7 +32,8 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
-from jax import lax
+
+from ..comm.collectives import all_reduce
 from jax.flatten_util import ravel_pytree
 
 
@@ -151,7 +152,7 @@ def momentum_sync(g_local, opt, cfg: OneBitLambConfig, dp_axes, frozen: bool,
     b1, b2 = cfg.betas
     if not frozen:
         def leaf(g, m, v):
-            g_avg = lax.pmean(g, dp_axes)
+            g_avg = all_reduce(g, dp_axes, op="mean")  # logged warmup comm
             return b1 * m + (1.0 - b1) * g_avg, b2 * v + (1.0 - b2) * g_avg * g_avg
 
         out = jax.tree.map(leaf, g_local, opt["m"], opt["v"])

@@ -39,7 +39,8 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
-from jax import lax
+
+from ..comm.collectives import all_reduce
 
 
 @dataclass(frozen=True)
@@ -160,7 +161,9 @@ def device_step(g, params, opt, lr, cfg: ZeroOneAdamConfig, dp_axes, phase):
 
     if kind == "warm":
         if on_grid:
-            g_avg = jax.tree.map(lambda x: lax.pmean(x, dp_axes), g)
+            # comm/ wrapper: the on-grid dense average is comm the X-ray
+            # must account (the off-grid 1-bit path logs via compressed.py)
+            g_avg = jax.tree.map(lambda x: all_reduce(x, dp_axes, op="mean"), g)
             v = jax.tree.map(lambda v_, ga: b2 * v_ + (1 - b2) * ga * ga, v, g_avg)
             m = jax.tree.map(lambda m_, ga: b1 * m_ + (1 - b1) * ga, m, g_avg)
         else:

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..comm.collectives import ppermute
+
 NEG_INF = -1e30
 
 
@@ -73,8 +75,10 @@ def ring_attention(q, k, v, axis_name: str = "context"):
         b = jnp.exp(m_b - m_new)
         o_acc = o_acc * a[..., None].swapaxes(1, 2) + o_b * b[..., None].swapaxes(1, 2)
         l_acc = l_acc * a + l_b * b
-        kj = lax.ppermute(kj, axis_name, perm)
-        vj = lax.ppermute(vj, axis_name, perm)
+        # comm/ wrapper (not bare lax): the collective X-ray's byte
+        # accounting must see the ring's per-hop KV traffic
+        kj = ppermute(kj, axis_name, perm)
+        vj = ppermute(vj, axis_name, perm)
         return (o_acc, m_new, l_acc, kj, vj), None
 
     o0 = jnp.zeros((B, Sq, H, Dh), jnp.float32)

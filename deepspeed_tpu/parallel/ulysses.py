@@ -25,7 +25,8 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import lax
+
+from ..comm.collectives import all_to_all
 
 
 def _local_attention(q, k, v, causal: bool):
@@ -50,10 +51,11 @@ def ulysses_attention(q, k, v, axis_name: str = "context", causal: bool = True):
 
     def seq_to_heads(x):
         # split the head dim across the axis, gather the full sequence
-        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+        # (comm/ wrapper so the collective X-ray's byte accounting sees it)
+        return all_to_all(x, axis_name, split_axis=2, concat_axis=1)
 
     def heads_to_seq(x):
-        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+        return all_to_all(x, axis_name, split_axis=1, concat_axis=2)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     out = _local_attention(qg, kg, vg, causal)

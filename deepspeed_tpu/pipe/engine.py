@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..comm.collectives import all_reduce, ppermute
 from ..runtime.engine import DeepSpeedEngine
 from ..utils.logging import log_dist
 
@@ -233,8 +234,10 @@ def pipeline_train_1f1b(
                 (stash, gstage, ghead, gx_all, loss_sum),
             )
 
-            fwd_msg = lax.ppermute(y_f, "pipe", [(i, i + 1) for i in range(S - 1)])
-            bwd_msg = lax.ppermute(gx_out, "pipe", [(i, i - 1) for i in range(1, S)])
+            # comm/ wrappers, not bare lax: the collective X-ray reconciles
+            # HLO collectives against this byte accounting
+            fwd_msg = ppermute(y_f, "pipe", [(i, i + 1) for i in range(S - 1)])
+            bwd_msg = ppermute(gx_out, "pipe", [(i, i - 1) for i in range(1, S)])
             trace = (
                 is_fwd.astype(jnp.int32), mF.astype(jnp.int32),
                 is_bwd.astype(jnp.int32), mB.astype(jnp.int32),
@@ -250,12 +253,13 @@ def pipeline_train_1f1b(
         # axes average what pjit's implicit psum does in the autodiff path —
         # each dp shard saw only its slice of every microbatch.
         n_dp = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
-        loss = lax.pmean(lax.psum(loss_sum, "pipe"), dp) / M
+        loss = all_reduce(all_reduce(loss_sum, "pipe"), dp, op="mean") / M
         # grads of the MEAN loss over microbatches (matching autodiff of the
         # model's batch-mean loss): divide the per-mb accumulation by M
-        ghead = jax.tree.map(lambda a: a / M, lax.pmean(lax.psum(ghead, "pipe"), dp))
-        gstage = jax.tree.map(lambda a: lax.pmean(a, dp) / M, gstage)
-        gx_all = lax.psum(gx_all, "pipe") / (n_dp * M)
+        ghead = jax.tree.map(
+            lambda a: a / M, all_reduce(all_reduce(ghead, "pipe"), dp, op="mean"))
+        gstage = jax.tree.map(lambda a: all_reduce(a, dp, op="mean") / M, gstage)
+        gx_all = all_reduce(gx_all, "pipe") / (n_dp * M)
         gstage_out = jax.tree.map(lambda a: a[None], gstage)  # [1, K, ...]
         trace = tuple(tr[None, :] for tr in trace)  # [1, ticks] per stage
         return loss, gstage_out, ghead, gx_all, trace
@@ -336,6 +340,16 @@ class PipelineEngine(DeepSpeedEngine):
             )
         # accumulation happens inside the pipeline scan
         self.gradient_accumulation_steps = 1
+        # 1F1B/GPipe bubble accounting for the step anatomy: the clocked
+        # schedule runs M + S - 1 ticks of which S - 1 are fill/drain
+        # (pipeline_apply docstring) — published as a gauge and attached to
+        # the train-step anatomy rows (telemetry/collective_ledger.py)
+        from ..telemetry.collective_ledger import pipeline_bubble_fraction
+
+        self.telemetry.ledger.set_pipeline(
+            self.num_stages, self.micro_batches, self._pipe_schedule)
+        self.telemetry.registry.gauge("train/pipe/bubble_fraction").set(
+            pipeline_bubble_fraction(self.num_stages, self.micro_batches))
         log_dist(
             f"pipeline engine: {self.num_stages} stages × "
             f"{model.layers_per_stage} layers, {self.micro_batches} microbatches",

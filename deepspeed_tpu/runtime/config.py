@@ -192,6 +192,31 @@ class MonitorBackendConfig:
 
 
 @dataclass
+class CollectiveLedgerConfig:
+    """Collective X-ray sub-block (``telemetry.ledger.collectives``;
+    ``telemetry/collective_ledger.py``, docs/PERF.md "Collective X-ray"):
+
+    - ``enabled``: parse each resolved program's post-optimization HLO for
+      collective ops (payload bytes, mesh-axis attribution, static
+      ``-start``/``-done`` overlap verdict) and derive the step-anatomy
+      rows in ``telemetry_snapshot()``. Rides the program ledger's
+      lazily-resolved executables — zero new XLA programs.
+    - ``ici_gbps``: per-chip one-way ICI bandwidth override in GB/s for the
+      comm-time model (0 = use the per-generation peak table; CPU/unknown
+      platforms stay unrated unless overridden).
+    """
+
+    enabled: bool = True
+    ici_gbps: float = 0.0
+
+    def __post_init__(self):
+        if self.ici_gbps < 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.ledger.collectives.ici_gbps must be >= 0, "
+                f"got {self.ici_gbps}")
+
+
+@dataclass
 class LedgerConfig:
     """Program-ledger sub-block (``telemetry.ledger``;
     ``telemetry/program_ledger.py``, docs/PERF.md):
@@ -203,12 +228,17 @@ class LedgerConfig:
       the compilation cache — no new program shapes, no hot-path cost.
     - ``hbm_warn_fraction``: the HBM ledger flags the snapshot when device
       bytes-in-use exceeds this fraction of the backend's memory limit.
+    - ``collectives``: collective X-ray sub-block (its own dataclass above).
     """
 
     enabled: bool = True
     hbm_warn_fraction: float = 0.9
+    collectives: CollectiveLedgerConfig = field(
+        default_factory=CollectiveLedgerConfig)
 
     def __post_init__(self):
+        if isinstance(self.collectives, dict):
+            self.collectives = _build(CollectiveLedgerConfig, self.collectives)
         if not (0.0 < self.hbm_warn_fraction <= 1.0):
             raise DeepSpeedConfigError(
                 f"telemetry.ledger.hbm_warn_fraction must be in (0, 1], "

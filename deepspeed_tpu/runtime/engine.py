@@ -158,12 +158,17 @@ class DeepSpeedEngine:
             watchdog_mode=tcfg.watchdog,
             device_sync_spans=tcfg.device_sync_spans,
             ledger=tcfg.ledger.enabled,
+            ledger_collectives=tcfg.ledger.collectives.enabled,
+            ici_gbps=tcfg.ledger.collectives.ici_gbps,
         )
         # program-ledger join rules: the train step's cost model reads its
         # measured wall time from the step-time histogram and publishes the
         # engine's headline train/mfu gauge (docs/PERF.md)
         self.telemetry.ledger.bind(
             "train/train_step", wall_hist="train/step_time_sec", gauge="train")
+        # the collective X-ray maps HLO replica groups back to axis names
+        # through the engine's own mesh (docs/PERF.md "Collective X-ray")
+        self.telemetry.ledger.set_mesh_shape(dict(self.mesh.shape))
         # wall-clock timers mirror into the same registry (utils/timer.py —
         # the standalone pre-spine path is deprecated)
         self.timers = SynchronizedWallClockTimer(registry=self.telemetry.registry)
@@ -923,17 +928,20 @@ class DeepSpeedEngine:
             )
             inv = 1.0 / (loss_scale * gas)
             g = _tree_scale(g, inv)
-            loss = lax.pmean(loss_sum / gas, dp_axes)
+            # comm/ wrappers (not bare lax.*) so the byte accounting the
+            # collective X-ray reconciles against sees these reductions
+            loss = dist.all_reduce(loss_sum / gas, dp_axes, op="mean")
             finite_local = jnp.all(
                 jnp.stack([jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(g)])
             )
-            finite = lax.pmin(finite_local.astype(jnp.int32), dp_axes)
+            finite = dist.all_reduce(
+                finite_local.astype(jnp.int32), dp_axes, op="min")
             # gradient-norm estimate: RMS-combined per-rank norms (exact when
             # shards agree; the exact global norm would need the full-grad
             # pmean the compressed stage exists to avoid)
-            gsq = lax.pmean(
+            gsq = dist.all_reduce(
                 jnp.sum(jnp.stack([jnp.sum(jnp.square(x)) for x in jax.tree.leaves(g)])),
-                dp_axes,
+                dp_axes, op="mean",
             )
             gnorm = jnp.sqrt(gsq)
             return loss, finite, gnorm, sync_fn(g, opt)
@@ -1052,14 +1060,16 @@ class DeepSpeedEngine:
                 micro, (zero, jnp.zeros((), jnp.float32)), batch_g
             )
             g = _tree_scale(g, 1.0 / (loss_scale * gas))
-            loss = lax.pmean(loss_sum / gas, dp_axes)
+            # routed through comm/ for the X-ray's byte accounting (above)
+            loss = dist.all_reduce(loss_sum / gas, dp_axes, op="mean")
             finite_local = jnp.all(
                 jnp.stack([jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(g)])
             )
-            finite = lax.pmin(finite_local.astype(jnp.int32), dp_axes)
-            gsq = lax.pmean(
+            finite = dist.all_reduce(
+                finite_local.astype(jnp.int32), dp_axes, op="min")
+            gsq = dist.all_reduce(
                 jnp.sum(jnp.stack([jnp.sum(jnp.square(x)) for x in jax.tree.leaves(g)])),
-                dp_axes,
+                dp_axes, op="mean",
             )
             gnorm = jnp.sqrt(gsq)
             params_new, opt_new = zo.device_step(g, params, opt, lr, obc, dp_axes, phase)

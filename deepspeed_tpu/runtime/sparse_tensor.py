@@ -18,7 +18,8 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax
+
+from ..comm.collectives import all_gather
 
 
 class SparseTensor(NamedTuple):
@@ -56,9 +57,11 @@ def sparse_all_reduce(st: SparseTensor, axis) -> SparseTensor:
     mesh axis (reference sparse_allreduce_bucket: all_gather of indices +
     values, engine.py:2323). Use inside shard_map; result rows = N * axis
     size, still row-sparse — densify with ``to_dense`` or keep sparse."""
-    idx = lax.all_gather(st.indices, axis, tiled=True)
-    vals = lax.all_gather(st.values, axis, tiled=True)
-    counts = lax.all_gather(st.count, axis)  # [world]
+    # comm/ wrappers (not bare lax) keep these gathers in the byte
+    # accounting the collective X-ray cross-checks
+    idx = all_gather(st.indices, axis)
+    vals = all_gather(st.values, axis)
+    counts = all_gather(st.count, axis, tiled=False)  # [world]
     # gathered blocks are [world * N]; each block's valid rows are its prefix,
     # so zero padded rows' values (they would otherwise scatter garbage)
     n = st.indices.shape[0]

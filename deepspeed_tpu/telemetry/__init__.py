@@ -24,6 +24,9 @@ instance each. Metric names follow ``subsystem/name``
 (docs/observability.md is the catalog).
 """
 
+from .collective_ledger import (CollectiveLedger, parse_hlo_collectives,
+                                pipeline_bubble_fraction, step_anatomy,
+                                summarize_collectives)
 from .exporters import JsonlExporter, MonitorBridge, prometheus_text
 from .program_ledger import (ProgramLedger, aot_cost, hbm_snapshot,
                              platform_peaks, tree_bytes)
@@ -38,7 +41,8 @@ __all__ = [
     "abstract_signature", "JsonlExporter", "MonitorBridge", "prometheus_text",
     "ProgramLedger", "aot_cost", "hbm_snapshot", "platform_peaks",
     "tree_bytes", "RequestTracer", "request_timeline", "to_perfetto",
-    "Telemetry",
+    "CollectiveLedger", "parse_hlo_collectives", "summarize_collectives",
+    "step_anatomy", "pipeline_bubble_fraction", "Telemetry",
 ]
 
 
@@ -54,12 +58,15 @@ class Telemetry:
 
     def __init__(self, registry: MetricsRegistry | None = None,
                  jsonl_path: str = "", watchdog_mode: str = "warn",
-                 device_sync_spans: bool = False, ledger: bool = True):
+                 device_sync_spans: bool = False, ledger: bool = True,
+                 ledger_collectives: bool = True, ici_gbps: float = 0.0):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.sink = JsonlExporter(jsonl_path) if jsonl_path else None
         self.tracer = SpanTracer(self.registry, self.sink,
                                  device_sync=device_sync_spans)
-        self.ledger = ProgramLedger(self.registry, enabled=ledger)
+        self.ledger = ProgramLedger(self.registry, enabled=ledger,
+                                    collectives=ledger_collectives,
+                                    ici_gbps=ici_gbps)
         self.watchdog = RecompileWatchdog(self.registry, self.sink,
                                           mode=watchdog_mode,
                                           ledger=self.ledger)
@@ -85,18 +92,39 @@ class Telemetry:
             self.sink.emit(event)
 
     def snapshot(self, **extra) -> dict:
-        """Registry snapshot + recompile table + program ledger (+ caller
-        extras), the one call that reports everything. The ledger table is
-        computed FIRST so the MFU/intensity gauges it publishes land in the
-        same metrics snapshot."""
+        """Registry snapshot + recompile table + program ledger + step
+        anatomy (+ caller extras), the one call that reports everything. The
+        ledger table and anatomy are computed FIRST so the MFU/intensity and
+        ``<prefix>/comm/*`` gauges they publish land in the same metrics
+        snapshot."""
         out: dict = {}
         if self.ledger.enabled and self.ledger.entries:
             out["program_ledger"] = self.ledger.table(self.registry)
+            out["step_anatomy"] = self.ledger.anatomy(self.registry)
             out["platform"] = dict(self.ledger.platform)
+            rec = self._comm_reconcile()
+            if rec:
+                out["comm_reconcile"] = rec
         out["metrics"] = self.registry.snapshot()
         out["recompile_table"] = self.watchdog.compile_table()
         out.update(extra)
         return out
+
+    def _comm_reconcile(self):
+        """Cross-check the host-side comm byte accounting (comm/logger.py)
+        against the HLO-derived per-axis totals — an axis XLA compiled
+        collectives over that the host accounting never saw is a collective
+        that bypassed the ``comm/`` wrappers (the report renders these as
+        labeled warnings, never averages them away)."""
+        coll = self.ledger.collectives
+        if not coll.programs:
+            return None
+        from ..comm.logger import comms_logger
+
+        if not comms_logger.enabled and not comms_logger.axis_totals():
+            return None  # no host accounting to reconcile against
+        return comms_logger.reconcile(coll.bytes_by_axis(),
+                                      mesh_shape=coll.mesh_shape)
 
     def close(self) -> None:
         if self.sink is not None:

@@ -50,23 +50,32 @@ from typing import Optional
 from ..utils.logging import logger
 
 # ---------------------------------------------------------------------------
-# per-platform peaks (dense bf16 TFLOPS per chip, HBM GB/s per chip).
-# Sources: Google Cloud TPU system-architecture docs (see docs/PERF.md for
-# the provenance table). A generation missing here degrades to the labeled
+# per-platform peaks (dense bf16 TFLOPS per chip, HBM GB/s per chip,
+# aggregate one-way ICI GB/s per chip — the Gbps figures in the Google
+# Cloud TPU system-architecture docs divided by 8; see docs/PERF.md for the
+# provenance table). A generation missing here degrades to the labeled
 # "unrated" entry — rows stay attributable, never wrong.
 # ---------------------------------------------------------------------------
 
 PEAKS: dict[str, dict] = {
-    "tpu_v2": {"label": "TPU v2", "peak_tflops": 45.0, "peak_hbm_gbps": 700.0},
-    "tpu_v3": {"label": "TPU v3", "peak_tflops": 123.0, "peak_hbm_gbps": 900.0},
-    "tpu_v4": {"label": "TPU v4", "peak_tflops": 275.0, "peak_hbm_gbps": 1228.0},
-    "tpu_v5e": {"label": "TPU v5e", "peak_tflops": 197.0, "peak_hbm_gbps": 819.0},
-    "tpu_v5p": {"label": "TPU v5p", "peak_tflops": 459.0, "peak_hbm_gbps": 2765.0},
-    "tpu_v6e": {"label": "TPU v6e", "peak_tflops": 918.0, "peak_hbm_gbps": 1640.0},
+    "tpu_v2": {"label": "TPU v2", "peak_tflops": 45.0, "peak_hbm_gbps": 700.0,
+               "peak_ici_gbps": 62.0},
+    "tpu_v3": {"label": "TPU v3", "peak_tflops": 123.0, "peak_hbm_gbps": 900.0,
+               "peak_ici_gbps": 82.0},
+    "tpu_v4": {"label": "TPU v4", "peak_tflops": 275.0, "peak_hbm_gbps": 1228.0,
+               "peak_ici_gbps": 300.0},
+    "tpu_v5e": {"label": "TPU v5e", "peak_tflops": 197.0, "peak_hbm_gbps": 819.0,
+                "peak_ici_gbps": 200.0},
+    "tpu_v5p": {"label": "TPU v5p", "peak_tflops": 459.0, "peak_hbm_gbps": 2765.0,
+                "peak_ici_gbps": 600.0},
+    "tpu_v6e": {"label": "TPU v6e", "peak_tflops": 918.0, "peak_hbm_gbps": 1640.0,
+                "peak_ici_gbps": 448.0},
     # CPU fallback: rows are LABELED but never rated against a TPU peak —
     # the same comparable-verdict discipline bench.py applies to its rows
-    "cpu": {"label": "cpu (unrated)", "peak_tflops": None, "peak_hbm_gbps": None},
-    "unknown": {"label": "unrated", "peak_tflops": None, "peak_hbm_gbps": None},
+    "cpu": {"label": "cpu (unrated)", "peak_tflops": None, "peak_hbm_gbps": None,
+            "peak_ici_gbps": None},
+    "unknown": {"label": "unrated", "peak_tflops": None, "peak_hbm_gbps": None,
+                "peak_ici_gbps": None},
 }
 
 # device_kind substrings -> PEAKS key, most specific first ("v5 lite" must
@@ -134,16 +143,20 @@ def _arg_spec(leaf):
     return leaf  # python scalars etc. lower as they were called
 
 
-def aot_cost(fn, args, kwargs=None) -> dict:
+def aot_cost(fn, args, kwargs=None, hlo: bool = False) -> dict:
     """Cost + memory analysis of ``fn`` lowered at ``args``' signature —
     ONE shared lower().compile() path for the ledger and the flops profiler
     (utils/jax_compat normalizes the per-version return shapes). Returns
     {flops, bytes_accessed, optimal_seconds?, argument_bytes, output_bytes,
     temp_bytes, alias_bytes, ...} with absent fields omitted; {} when the
-    function can't be lowered or the backend has no cost model."""
+    function can't be lowered or the backend has no cost model.
+    ``hlo=True`` additionally includes ``hlo_text`` (the post-optimization
+    HLO of the SAME compiled artifact — the collective ledger's input;
+    callers pop it rather than carrying megabytes into snapshots)."""
     import jax
 
-    from ..utils.jax_compat import compiled_cost_analysis, compiled_memory_stats
+    from ..utils.jax_compat import (compiled_cost_analysis,
+                                    compiled_hlo_text, compiled_memory_stats)
 
     lower = getattr(fn, "lower", None)
     if lower is None:
@@ -163,6 +176,8 @@ def aot_cost(fn, args, kwargs=None) -> dict:
         if opt > 0:
             out["optimal_seconds"] = opt
     out.update(compiled_memory_stats(compiled))
+    if hlo:
+        out["hlo_text"] = compiled_hlo_text(compiled)
     return out
 
 
@@ -181,7 +196,10 @@ class ProgramLedger:
     intensity as registry gauges so ``telemetry_snapshot()`` carries them.
     """
 
-    def __init__(self, registry=None, enabled: bool = True):
+    def __init__(self, registry=None, enabled: bool = True,
+                 collectives: bool = True, ici_gbps: float = 0.0):
+        from .collective_ledger import CollectiveLedger
+
         self.enabled = enabled
         self.registry = registry
         self.entries: dict[str, dict] = {}   # name -> resolved/static row
@@ -189,6 +207,13 @@ class ProgramLedger:
         # (prefix, wall_hist, gauge_prefix) join rules, first match wins
         self._bindings: list[tuple[str, str, Optional[str]]] = []
         self._peaks: Optional[dict] = None
+        # collective X-ray (telemetry/collective_ledger.py): HLO-parsed
+        # per-collective summaries from the SAME lazily-resolved executables
+        self.collectives = CollectiveLedger(enabled=enabled and collectives)
+        # operator override for odd topologies / tests; 0 = use the peak
+        # table's per-generation entry
+        self._ici_gbps = float(ici_gbps) or None
+        self._pipeline: Optional[dict] = None  # set by the pipeline engine
 
     @property
     def platform(self) -> dict:
@@ -205,6 +230,26 @@ class ProgramLedger:
         """Override peak resolution (tests pin a synthetic platform so MFU
         math is checked against hand-computed fixtures)."""
         self._peaks = dict(peaks)
+
+    def set_mesh_shape(self, mesh_shape: dict) -> None:
+        """Teach the collective ledger the engine's mesh axis sizes (in mesh
+        axis order) so HLO replica groups map back to axis NAMES."""
+        self.collectives.set_mesh_shape(mesh_shape)
+
+    def set_pipeline(self, num_stages: int, micro_batches: int,
+                     schedule: str) -> None:
+        """Pipeline-engine nomination: attach the clocked schedule's bubble
+        accounting (ticks = M+S-1, bubble = S-1 of them) to the train-step
+        anatomy rows."""
+        from .collective_ledger import pipeline_bubble_fraction
+
+        self._pipeline = {
+            "num_stages": int(num_stages),
+            "micro_batches": int(micro_batches),
+            "schedule": schedule,
+            "bubble_fraction": pipeline_bubble_fraction(
+                num_stages, micro_batches),
+        }
 
     # -- capture (watchdog compile-detection path) -----------------------
 
@@ -262,12 +307,23 @@ class ProgramLedger:
             fn, specs, kw_specs = self._pending.pop(name)
             row = self.entries[name]
             try:
-                cost = aot_cost(fn, specs, kw_specs)
+                cost = aot_cost(fn, specs, kw_specs,
+                                hlo=self.collectives.enabled)
             # dstpu: allow[broad-except] -- lazy AOT cost resolution calls backend introspection that raises version/backend-specific types; the row records the error string and the snapshot stays serveable
             except Exception as e:  # noqa: BLE001 — introspection only
                 row["error"] = f"{type(e).__name__}: {e}"
                 logger.debug(f"program ledger resolve failed for {name!r}: {e}")
                 continue
+            # the HLO text feeds the collective X-ray and is NOT kept on the
+            # row (megabytes per program; the summary is what snapshots carry)
+            hlo_text = cost.pop("hlo_text", "")
+            if hlo_text:
+                try:
+                    self.collectives.record(name, hlo_text)
+                # dstpu: allow[broad-except] -- the collective parse is best-effort observability over backend-formatted text; a malformed module must degrade to "no collective view", never fail the snapshot
+                except Exception as e:  # noqa: BLE001
+                    logger.debug(
+                        f"collective ledger parse failed for {name!r}: {e}")
             row.update(cost)
             flops = row.get("flops")
             by = row.get("bytes_accessed")
@@ -339,6 +395,50 @@ class ProgramLedger:
                         derived["arith_intensity"])
             rows.append(derived)
         return sorted(rows, key=lambda r: -(r.get("flops") or 0.0))
+
+    def anatomy(self, registry=None) -> list[dict]:
+        """Step-anatomy rows (telemetry/collective_ledger.step_anatomy): one
+        per program, joining the cost model, the measured wall time, the
+        platform peaks (incl. ICI) and the HLO collective summary into
+        {compute_time_s, hbm_time_s, comm_time_by_axis,
+        exposed_comm_estimate_s, overlap_verdict}. Publishes the nominated
+        ``<gauge>/comm/*`` gauges as a side effect (call BEFORE snapshotting
+        the registry). Unrated platforms keep static facts with labeled null
+        times — never a fabricated comm roofline."""
+        from .collective_ledger import step_anatomy
+
+        self.resolve()
+        registry = registry if registry is not None else self.registry
+        peaks = self.platform
+        rows = []
+        published: set[str] = set()
+        for name, row in self.entries.items():
+            wall = None
+            wall_hist, gauge = self._binding(name)
+            if registry is not None and wall_hist is not None:
+                h = registry.get(wall_hist)
+                if h is not None and hasattr(h, "summary"):
+                    wall = h.summary()
+            arow = step_anatomy(row, wall, peaks,
+                                self.collectives.get(name),
+                                ici_gbps=self._ici_gbps)
+            if self._pipeline is not None and name.startswith("train/"):
+                arow["pipeline"] = dict(self._pipeline)
+            if (registry is not None and gauge is not None
+                    and gauge not in published):
+                # same first-captured-program-owns-the-gauge rule as table()
+                published.add(gauge)
+                if arow.get("comm_time_s") is not None:
+                    registry.gauge(f"{gauge}/comm/time_s").set(
+                        arow["comm_time_s"])
+                if arow.get("exposed_comm_estimate_s") is not None:
+                    registry.gauge(f"{gauge}/comm/exposed_s").set(
+                        arow["exposed_comm_estimate_s"])
+                if arow.get("comm_bytes_total"):
+                    registry.gauge(f"{gauge}/comm/bytes").set(
+                        arow["comm_bytes_total"])
+            rows.append(arow)
+        return rows
 
 
 # ---------------------------------------------------------------------------

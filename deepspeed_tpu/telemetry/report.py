@@ -1,7 +1,7 @@
 """Run-summary CLI over a telemetry JSONL event log.
 
     python -m deepspeed_tpu.telemetry.report run.jsonl [--top 10]
-        [--json] [--request UID] [--perfetto out.json]
+        [--json] [--request UID] [--step-anatomy] [--perfetto out.json]
 
 Pretty-prints, for CI logs and bench triage:
 
@@ -33,10 +33,19 @@ Query modes:
     admitted -> chunk k -> first_token -> terminal, plus quarantine/failover
     edges), merged across the router and every replica when the snapshot
     came from a fleet.
+  * ``--step-anatomy`` — the collective X-ray's step anatomy: per watched
+    program, modeled compute/HBM/comm-by-axis time, the exposed-comm
+    estimate (wall beyond the slower roof), and the static overlap verdict
+    read from the compiled HLO (telemetry/collective_ledger.py; unrated
+    platforms keep labeled ``-`` times, never fabricated ones).
   * ``--perfetto out.json`` — export every request timeline in the last
     snapshot as Chrome-trace JSON (load in ui.perfetto.dev).
   * ``--json`` — machine-readable output: ``{snapshot, roofline, hbm,
-    requests[, request_timeline]}`` for CI and bench tooling.
+    step_anatomy, comm_reconcile, requests[, request_timeline]}`` for CI
+    and bench tooling.
+
+The default summary additionally flags comm-reconcile mismatches (host
+byte accounting vs the HLO-derived collective counts) as labeled warnings.
 
 Pure stdlib + host-side: safe to run anywhere the JSONL landed (no jax
 import, no device).
@@ -109,6 +118,29 @@ def ledger_rows(snap: dict | None) -> list[dict]:
     rows = [dict(r) for r in snap.get("program_ledger") or []]
     for rid, rep in (snap.get("replicas") or {}).items():
         for r in rep.get("program_ledger") or []:
+            rows.append({"replica": rid, **r})
+    return rows
+
+
+def anatomy_rows(snap: dict | None) -> list[dict]:
+    """Step-anatomy rows from a snapshot — the engine's own plus, for a
+    Router snapshot, every replica's (rows gain a ``replica`` key)."""
+    if not snap:
+        return []
+    rows = [dict(r) for r in snap.get("step_anatomy") or []]
+    for rid, rep in (snap.get("replicas") or {}).items():
+        for r in rep.get("step_anatomy") or []:
+            rows.append({"replica": rid, **r})
+    return rows
+
+
+def reconcile_rows(snap: dict | None) -> list[dict]:
+    """comm-reconcile rows (host byte accounting vs HLO-derived counts)."""
+    if not snap:
+        return []
+    rows = [dict(r) for r in snap.get("comm_reconcile") or []]
+    for rid, rep in (snap.get("replicas") or {}).items():
+        for r in rep.get("comm_reconcile") or []:
             rows.append({"replica": rid, **r})
     return rows
 
@@ -252,6 +284,24 @@ def summarize(events: list[dict], top: int = 10) -> str:
                 lines.append(
                     f"{prefix}pool total {_fmt_qty(h.get('pool_total_bytes'), 'B')} "
                     "(backend reports no memory stats)")
+        lines.append("")
+
+    # -- comm reconcile warnings ----------------------------------------
+    # host byte accounting vs HLO-derived collectives (comm/logger.py
+    # reconcile): a mismatch is SURFACED as a labeled warning, never
+    # silently averaged away — an axis XLA collected over that the host
+    # never logged is a collective that bypassed the comm/ wrappers
+    rrows = reconcile_rows(snap)
+    bad = [r for r in rrows if r.get("verdict") != "ok"]
+    if bad:
+        lines.append("comm reconcile WARNINGS (host accounting vs HLO):")
+        for r in bad:
+            prefix = (f"  [{r['replica']}] " if r.get("replica") is not None
+                      else "  ")
+            lines.append(
+                f"{prefix}axis {r['axis']}: {r['verdict']} — host "
+                f"{r['host_count']} ops / {_fmt_qty(r['host_bytes'], 'B')}, "
+                f"hlo {r['hlo_count']} ops / {_fmt_qty(r['hlo_bytes'], 'B')}")
         lines.append("")
 
     # -- requests -------------------------------------------------------
@@ -419,6 +469,54 @@ def request_table(events: list[dict]) -> list[dict]:
             for ev in events if ev.get("type") == "request"]
 
 
+def format_step_anatomy(snap: dict | None, top: int = 10) -> str:
+    """Render the step-anatomy table (``--step-anatomy``): per watched
+    program, where the milliseconds go — modeled compute/HBM/comm-by-axis
+    time, the exposed-comm estimate, and the static overlap verdict read
+    from the compiled HLO. Unrated platforms show labeled ``-`` times."""
+    rows = anatomy_rows(snap)
+    if not rows:
+        return "no step-anatomy rows in the last snapshot\n"
+
+    def _t(v):
+        return _fmt_s(v) if v is not None else "-"
+
+    lines = [f"step anatomy ({len(rows)} programs):",
+             f"  {'program':<34} {'wall p50':>9} {'compute':>9} {'hbm':>9} "
+             f"{'comm':>9} {'exposed':>9}  overlap"]
+    for r in rows[:top]:
+        name = r.get("name", "?")
+        if r.get("replica") is not None:
+            name = f"[{r['replica']}] {name}"
+        lines.append(
+            f"  {name:<34} {_t(r.get('wall_p50_s')):>9} "
+            f"{_t(r.get('compute_time_s')):>9} {_t(r.get('hbm_time_s')):>9} "
+            f"{_t(r.get('comm_time_s')):>9} "
+            f"{_t(r.get('exposed_comm_estimate_s')):>9}  "
+            f"{r.get('overlap_verdict', '?')}")
+        ctba = r.get("comm_time_by_axis")
+        cbba = r.get("comm_bytes_by_axis") or {}
+        if ctba:
+            lines.append("      comm by axis: " + " ".join(
+                f"{ax}={_fmt_s(t)} ({_fmt_qty(cbba.get(ax), 'B')})"
+                for ax, t in sorted(ctba.items())))
+        elif cbba:
+            lines.append("      comm bytes by axis (unrated, no time "
+                         "model): " + " ".join(
+                             f"{ax}={_fmt_qty(b, 'B')}"
+                             for ax, b in sorted(cbba.items())))
+        pipe = r.get("pipeline")
+        if pipe:
+            lines.append(
+                f"      pipeline: {pipe.get('num_stages')} stages x "
+                f"{pipe.get('micro_batches')} microbatches "
+                f"({pipe.get('schedule')}), bubble "
+                f"{pipe.get('bubble_fraction', 0.0):.1%}")
+    if len(rows) > top:
+        lines.append(f"  ... +{len(rows) - top} more programs")
+    return "\n".join(lines) + "\n"
+
+
 def format_timeline(timeline: list[dict]) -> str:
     """Render one request's merged lifecycle timeline."""
     if not timeline:
@@ -447,6 +545,10 @@ def main(argv=None) -> int:
                          "hbm, requests[, request_timeline]}")
     ap.add_argument("--request", type=int, default=None, metavar="UID",
                     help="print one request's merged lifecycle timeline")
+    ap.add_argument("--step-anatomy", action="store_true",
+                    help="print the step-anatomy table (compute/hbm/comm "
+                         "time split, exposed-comm estimate, HLO overlap "
+                         "verdict per program)")
     ap.add_argument("--perfetto", metavar="PATH", default=None,
                     help="write the last snapshot's request timelines as "
                          "Chrome-trace JSON (ui.perfetto.dev)")
@@ -467,6 +569,8 @@ def main(argv=None) -> int:
             "snapshot": snap,
             "roofline": ledger_rows(snap),
             "hbm": hbm_tables(snap),
+            "step_anatomy": anatomy_rows(snap),
+            "comm_reconcile": reconcile_rows(snap),
             "requests": request_table(events),
         }
         if args.request is not None:
@@ -479,6 +583,10 @@ def main(argv=None) -> int:
     if args.request is not None:
         print(format_timeline(request_timeline(snap or {}, uid=args.request)),
               end="")
+        return 0
+
+    if args.step_anatomy:
+        print(format_step_anatomy(snap, top=args.top), end="")
         return 0
 
     if args.perfetto:

@@ -71,6 +71,7 @@ def axis_size(axis):
 
     if hasattr(lax, "axis_size"):
         return lax.axis_size(axis)
+    # dstpu: allow[unlogged-collective] -- size probe, not data movement: psum of the constant 1 constant-folds to the static axis size (zero bytes on the wire), and comm/ itself calls this shim
     return lax.psum(1, axis)
 
 
@@ -90,6 +91,24 @@ def compiled_cost_analysis(compiled) -> dict:
     if isinstance(ca, (list, tuple)):
         ca = ca[0] if ca else None
     return dict(ca) if ca else {}
+
+
+def compiled_hlo_text(compiled) -> str:
+    """Post-optimization HLO text of a ``lower().compile()`` artifact — the
+    collective ledger's input (telemetry/collective_ledger.py). Every
+    caller comes through here so the version shim lives in ONE place:
+    ``Compiled.as_text()`` where the build provides it, "" where it is
+    absent or the backend refuses serialization — callers treat "" as
+    "no collective view", never an error."""
+    fn = getattr(compiled, "as_text", None)
+    if fn is None:
+        return ""
+    try:
+        text = fn()
+    # dstpu: allow[broad-except] -- version shim: same contract as compiled_cost_analysis — HLO rendering raises backend/version-specific types, "" is the degraded answer
+    except Exception:
+        return ""
+    return str(text) if text else ""
 
 
 def compiled_memory_stats(compiled) -> dict:
@@ -114,4 +133,5 @@ def compiled_memory_stats(compiled) -> dict:
 
 
 __all__ = ["shard_map", "axis_size", "memory_space", "device_put_host",
-           "compiled_cost_analysis", "compiled_memory_stats"]
+           "compiled_cost_analysis", "compiled_memory_stats",
+           "compiled_hlo_text"]

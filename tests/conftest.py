@@ -109,6 +109,21 @@ def pytest_sessionfinish(session, exitstatus):
     except Exception as e:  # noqa: BLE001 — advisory only, never fails a run
         print(f"[conftest] dstpu-lint verdict skipped: {e}")
 
+    # One-line BENCH-trajectory verdict beside the budget and lint lines:
+    # the r04/r05 flatline went unnoticed for two rounds — a full run now
+    # states the comparable-row regression verdict every session. Warn-only.
+    traj = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "bin", "bench_trajectory")
+    repo = os.path.dirname(os.path.dirname(__file__))
+    try:
+        proc = subprocess.run([sys.executable, traj, "--dir", repo],
+                              capture_output=True, text=True, timeout=30)
+        out = (proc.stdout.strip().splitlines()
+               + proc.stderr.strip().splitlines()) or ["no output"]
+        print(f"-- {out[-1]} (bin/bench_trajectory, warn-only) --")
+    except Exception as e:  # noqa: BLE001 — advisory only, never fails a run
+        print(f"[conftest] bench-trajectory verdict skipped: {e}")
+
 
 @pytest.fixture(scope="session")
 def tiny_serving_engine():

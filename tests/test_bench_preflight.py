@@ -24,19 +24,24 @@ def bench():
 
 def test_stamp_row_platform_and_comparable(bench):
     # every row also carries the perf-xray keys: mfu null / roofline
-    # "unrated:<platform>" unless the child computed real ones
+    # "unrated:<platform>" / step_anatomy null unless the child computed
+    # real ones
     assert bench._stamp_row({"platform": "tpu"}, "full") == {
         "platform": "tpu", "bench_stage": "full", "comparable": True,
-        "mfu": None, "roofline": "unrated:tpu"}
+        "mfu": None, "roofline": "unrated:tpu", "step_anatomy": None}
     assert bench._stamp_row({"platform": "cpu"}, "cpu_fallback")["comparable"] is False
     # a row that never ran anywhere stamps platform "none", non-comparable
     row = bench._stamp_row({}, "none")
     assert row["platform"] == "none" and row["comparable"] is False
     assert row["mfu"] is None and row["roofline"] == "unrated:none"
+    assert row["step_anatomy"] is None  # labeled null, never fabricated
     # child-computed values are never overwritten by the stamp
     rated = bench._stamp_row({"platform": "tpu", "mfu": 0.41,
-                              "roofline": "compute-bound"}, "full")
+                              "roofline": "compute-bound",
+                              "step_anatomy": {"overlap_verdict": "overlapped"}},
+                             "full")
     assert rated["mfu"] == 0.41 and rated["roofline"] == "compute-bound"
+    assert rated["step_anatomy"]["overlap_verdict"] == "overlapped"
 
 
 def test_preflight_retries_with_bounded_backoff(bench):

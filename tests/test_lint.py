@@ -47,7 +47,7 @@ def findings_for(res, rule):
 def test_registry_has_the_shipped_rules():
     expected = {"wall-clock-verdict", "broad-except", "blocking-under-lock",
                 "unguarded-donation", "rename-durability",
-                "socket-discipline",
+                "socket-discipline", "unlogged-collective",
                 "config-doc-drift", "metric-doc-drift",
                 "pragma", "parse-error"}
     assert expected <= set(RULES)
@@ -353,6 +353,65 @@ def test_socket_discipline_pragma_with_rationale_suppresses(tmp_path):
     """})
     res = run_lint(pkg, rule_ids=["socket-discipline"])
     assert not findings_for(res, "socket-discipline")
+    assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# unlogged-collective
+
+
+def test_unlogged_collective_flags_bare_lax_calls(tmp_path):
+    pkg = make_tree(tmp_path, {"parallel/x.py": """\
+        from jax import lax
+        def reduce(x, axis):
+            return lax.psum(x, axis)
+    """})
+    res = run_lint(pkg, rule_ids=["unlogged-collective"])
+    (f,) = findings_for(res, "unlogged-collective")
+    assert "lax.psum" in f.message and "comm" in f.message
+
+
+def test_unlogged_collective_flags_bare_name_import(tmp_path):
+    # `from jax.lax import ppermute as pp` is the same bypass in disguise
+    pkg = make_tree(tmp_path, {"parallel/x.py": """\
+        from jax.lax import ppermute as pp
+        def shift(x, axis, perm):
+            return pp(x, axis, perm)
+    """})
+    res = run_lint(pkg, rule_ids=["unlogged-collective"])
+    (f,) = findings_for(res, "unlogged-collective")
+    assert "ppermute" in f.message
+
+
+def test_unlogged_collective_comm_wrappers_are_clean(tmp_path):
+    # the sanctioned home (comm/collectives.py) and callers routing through
+    # it are both clean; non-collective lax calls never flag
+    pkg = make_tree(tmp_path, {
+        "comm/collectives.py": """\
+            from jax import lax
+            def all_reduce(x, axis):
+                return lax.psum(x, axis)
+        """,
+        "runtime/x.py": """\
+            from jax import lax
+            from ..comm.collectives import all_reduce
+            def step(x, axis):
+                y = lax.stop_gradient(x)
+                return all_reduce(y, axis)
+        """})
+    res = run_lint(pkg, rule_ids=["unlogged-collective"])
+    assert not findings_for(res, "unlogged-collective")
+
+
+def test_unlogged_collective_pragma_with_rationale_suppresses(tmp_path):
+    pkg = make_tree(tmp_path, {"utils/x.py": """\
+        from jax import lax
+        def axis_size(axis):
+            # dstpu: allow[unlogged-collective] -- size probe: psum of a constant 1 constant-folds, zero wire bytes
+            return lax.psum(1, axis)
+    """})
+    res = run_lint(pkg, rule_ids=["unlogged-collective"])
+    assert not findings_for(res, "unlogged-collective")
     assert len(res.suppressed) == 1
 
 
