@@ -60,6 +60,17 @@ parity on the completed set, brownout engaged while saturated at max, and
 no worker compiled a second decode program. Prints one JSON line with
 scale/respawn/brownout/shed counts and p99 TTFT.
 
+Gateway chaos drill (``python bench.py --gateway-chaos [--gateway-seed N]``,
+CI tier): the HTTP/SSE front door end-to-end — real worker processes over
+the TCP transport behind a real ``launcher/http_gateway`` server, open-loop
+HTTP clients with heavy-tail prompts, mid-stream client disconnects
+(RST'd sockets), one worker SIGKILL, and a rolling fleet upgrade under
+live traffic. ASSERTS the front-door contract: zero accepted-request
+loss, disconnect→cancel frees slots (occupancy and prefix refs back to
+0), bitwise greedy parity on completed requests vs an unfaulted
+single-engine run, all upgrade waves complete, watchdog raise everywhere.
+Prints one JSON line.
+
 Chaos soak drill (``python bench.py --chaos [steps] [--chaos-seed N]``, CI
 tier): a supervisor loop trains a tiny model to a target step count under
 seeded random preemptions (each takes a just-in-time ``preempt``-tag
@@ -330,11 +341,7 @@ def _fault_smoke(rate: float) -> int:
         "value": int(recovered),
         "unit": "requests",
         # CPU-pinned correctness smoke: never a trajectory datapoint
-        "platform": "cpu",
-        "comparable": False,
-        "mfu": None,
-        "roofline": "unrated:cpu",
-        "step_anatomy": None,
+        **_drill_stamp(),
         "fault_rate": rate,
         "n_requests": len(reqs),
         "statuses": dict(statuses),
@@ -472,11 +479,7 @@ def _chaos(steps: int, seed: int) -> int:
                      + tallies["nan_skipped_steps"]),
         "unit": "faults",
         # CPU-pinned correctness soak: never a trajectory datapoint
-        "platform": "cpu",
-        "comparable": False,
-        "mfu": None,
-        "roofline": "unrated:cpu",
-        "step_anatomy": None,
+        **_drill_stamp(),
         "target_steps": steps,
         "survivor_steps": survivor_steps,
         "generations": generations,
@@ -684,11 +687,7 @@ def _chaos_serving(seed: int) -> int:
             "value": int(stats["failovers_recovered"]),
             "unit": "requests",
             # CPU-pinned correctness soak: never a trajectory datapoint
-            "platform": "cpu",
-            "comparable": False,
-            "mfu": None,
-            "roofline": "unrated:cpu",
-            "step_anatomy": None,
+            **_drill_stamp(),
             "workers": 3,
             "kills": {"mid_prefill_rid": victim_prefill,
                       "mid_decode_rid": victim_decode},
@@ -907,11 +906,7 @@ def _surge(n_requests: int, seed: int) -> int:
                          + asc_c.get("respawns", 0)),
             "unit": "events",
             # CPU-pinned correctness soak: never a trajectory datapoint
-            "platform": "cpu",
-            "comparable": False,
-            "mfu": None,
-            "roofline": "unrated:cpu",
-            "step_anatomy": None,
+            **_drill_stamp(),
             "n_requests": len(prompts),
             "accepted": len(submitted),
             "rejected_at_submit": dict(
@@ -928,6 +923,353 @@ def _surge(n_requests: int, seed: int) -> int:
         return 0
     finally:
         sup.shutdown()
+
+
+def _gateway_chaos(seed: int) -> int:
+    """Front-door chaos drill (``bench.py --gateway-chaos``): REAL worker
+    processes (TCP transport) behind a REAL HTTP/SSE gateway, driven by
+    open-loop HTTP clients with heavy-tail prompts. Mid-trace: several
+    clients DISCONNECT mid-stream, one worker is SIGKILL'd (recovered via
+    supervisor respawn + attach), and a rolling upgrade replaces every
+    worker generation under live traffic. ASSERTS: zero accepted-request
+    loss (every uid the gateway accepted reaches a terminal state —
+    disconnected streams terminate ``cancelled``, their slots freed),
+    bitwise greedy parity on COMPLETED requests vs an unfaulted
+    single-engine run, slot AND prefix-pool-ref occupancy back to 0 on
+    every live replica, the rolling upgrade completing with all waves
+    ``upgraded``, and the RecompileWatchdog in RAISE mode everywhere (ONE
+    decode program per worker). CPU-pinned correctness soak, never a
+    trajectory datapoint."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests", ".xla_cache"))
+    import signal
+    import socket as socket_mod
+    import struct
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.inference import InferenceEngine, Router
+    from deepspeed_tpu.inference.serving import Request, ServingEngine
+    from deepspeed_tpu.launcher.http_gateway import HttpGateway
+    from deepspeed_tpu.launcher.serving_worker import WorkerSupervisor
+    from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+    t0 = time.perf_counter()
+    serving_cfg = {
+        "n_slots": 2, "max_seq_len": 128, "watchdog_mode": "raise",
+        # chunked prefill + prefix cache: the full program inventory under
+        # kill/upgrade churn, and prefix-ref accounting to prove clean
+        "chunked_prefill": {"enabled": True, "chunk_size": 16},
+        "prefix_cache": {"enabled": True, "n_slots": 4, "block": 4,
+                         "insert_policy": "always", "min_hits": 1},
+    }
+    model_spec = {"vocab_size": 97, "max_seq_len": 128, "num_layers": 2,
+                  "num_heads": 4, "hidden_size": 32, "dtype": "float32",
+                  "loss_chunk_size": 0, "decode_attn": "xla",
+                  "pos_emb": "rotary"}
+    spec = {"model": model_spec, "engine_dtype": "fp32",
+            "serving": serving_cfg}
+
+    # -- the trace: open-loop bursts, heavy-tail prompts, a shared prefix
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(0, 97, size=12).astype(np.int32)  # prefix bait
+    n_req = 15
+    prompts, offsets, disconnect_after = {}, {}, {}
+    for i in range(n_req):
+        heavy = rng.random() < 0.25
+        tail = rng.integers(0, 97, size=int(
+            rng.integers(40, 80) if heavy else rng.integers(4, 16)))
+        if rng.random() < 0.4:  # shared-prefix traffic warms the pool
+            prompts[i] = np.concatenate([shared, tail]).astype(np.int32)
+        else:
+            prompts[i] = tail.astype(np.int32)
+        # burst A lands immediately; burst B spans the kill-recovery and
+        # rolling-upgrade window so both happen under live streams
+        offsets[i] = (float(rng.uniform(0.0, 0.5)) if i < 6
+                      else float(rng.uniform(2.0, 9.0)))
+    for i in (1, 7, 10):  # mid-stream disconnectors (2-4 tokens in)
+        disconnect_after[i] = int(rng.integers(2, 5))
+
+    def mk(i):
+        return Request(uid=1000 + i, prompt=prompts[i], max_new_tokens=24)
+
+    # -- unfaulted single-engine reference (identical PRNGKey(0) params) --
+    cfg = TransformerConfig(**{**model_spec, "dtype": jnp.float32})
+    ref_srv = ServingEngine(
+        InferenceEngine(model=Model(cfg), config={"dtype": "fp32"}),
+        config=serving_cfg)
+    for i in sorted(prompts):
+        ref_srv.submit(mk(i))
+    ref = {u - 1000: r.tokens for u, r in ref_srv.drain().items()}
+
+    # -- the fleet: 3 TCP workers + supervisor + router + gateway ---------
+    sup = WorkerSupervisor(
+        spec, 3,
+        transport={"family": "tcp", "host": "127.0.0.1", "port_base": 0,
+                   "call_timeout_s": 120.0, "boot_timeout_s": 300.0,
+                   "heartbeat_timeout_s": 30.0, "base_delay_s": 0.05,
+                   "max_delay_s": 0.2, "jitter": 0.0},
+        respawn_backoff={"max_attempts": 10, "base_delay_s": 0.2,
+                         "max_delay_s": 1.0, "jitter": 0.25},
+        seed=seed)
+    state = {"slots": {}, "respawns": 0, "upgrade_started": False,
+             "killed_slot": None}
+    try:
+        clients = sup.start()
+        router = Router(config={"router": {"replicas": 3, "max_queue_len": 16,
+                                           "health": {"timeout": 60.0}}},
+                        replica_engines=clients)
+        state["slots"] = {0: 0, 1: 1, 2: 2}
+        kill_at = [None]  # router-clock kill time, armed once serving
+
+        def on_tick():
+            # runs on the gateway's serve loop thread — the only thread
+            # allowed to mutate fleet membership. Respawn BOOTS run on a
+            # background thread (the autoscaler's discipline): a boot
+            # inline here would freeze every client's token stream for
+            # its duration — exactly the stall PR 11 removed
+            now = router.now()
+            if (state["killed_slot"] is None and kill_at[0] is not None
+                    and now >= kill_at[0] and router._owner):
+                victim = router.owner_of(next(iter(router._owner)))
+                if victim is not None and victim in state["slots"]:
+                    state["killed_slot"] = state["slots"][victim]
+                    sup.kill(state["killed_slot"], signal.SIGKILL)
+            boot = state.get("boot")
+            if boot is not None and not boot["thread"].is_alive():
+                state["boot"] = None
+                if boot.get("client") is not None:
+                    new_rid = router.attach_replica(boot["client"])
+                    state["slots"][new_rid] = boot["slot"]
+                    state["respawns"] += 1
+            for slot in sup.poll():
+                if state.get("boot") is not None:
+                    break  # one replacement boot at a time (1 kill planned)
+                rid = next((r for r, s in state["slots"].items()
+                            if s == slot), None)
+                if rid is not None:
+                    router.mark_dead(rid)  # corpse: immediate dead verdict
+                    state["slots"].pop(rid)
+                holder = {"slot": slot, "client": None}
+
+                def boot_run(holder=holder):
+                    holder["client"] = sup.respawn(holder["slot"])
+
+                holder["thread"] = threading.Thread(target=boot_run,
+                                                    daemon=True)
+                state["boot"] = holder
+                holder["thread"].start()
+            if (not state["upgrade_started"] and state["respawns"] >= 1
+                    and sum(1 for s in router.replica_states().values()
+                            if s == "healthy") >= 3):
+                # the corpse is recovered: roll the whole fleet to the new
+                # generation spec while burst B streams through it
+                state["upgrade_started"] = True
+                new_spec = dict(spec)
+                new_spec["serving"] = {**serving_cfg, "seed": seed + 1}
+                router.rolling_upgrade(supervisor=sup,
+                                       slots=dict(state["slots"]),
+                                       spec=new_spec)
+
+        gw = HttpGateway(router, {"stream_poll_s": 0.01,
+                                  "write_timeout_s": 30.0},
+                         on_tick=on_tick)
+        gw.start()
+        kill_at[0] = router.now() + 1.5
+
+        # -- open-loop HTTP clients --------------------------------------
+        outcomes: dict[int, dict] = {}
+
+        def client(i):
+            time.sleep(offsets[i])
+            out = {"i": i}
+            outcomes[i] = out
+            body = json.dumps({"prompt": [int(t) for t in prompts[i]],
+                               "max_new_tokens": 24}).encode()
+            req = (b"POST /v1/generate HTTP/1.1\r\nHost: gw\r\n"
+                   b"Content-Length: %d\r\n\r\n" % len(body)) + body
+            s = socket_mod.create_connection(("127.0.0.1", gw.port),
+                                             timeout=240.0)
+            try:
+                s.sendall(req)
+                data, headers_done = b"", False
+                while True:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    data += chunk
+                    if not headers_done and b"\r\n\r\n" in data:
+                        headers_done = True
+                        head, data = data.split(b"\r\n\r\n", 1)
+                        out["status_code"] = int(
+                            head.split(b" ", 2)[1].decode())
+                        for line in head.split(b"\r\n"):
+                            if line.lower().startswith(b"x-dstpu-uid:"):
+                                out["uid"] = int(line.split(b":")[1])
+                    n_tok = data.count(b"event: token")
+                    if (i in disconnect_after and out.get("uid") is not None
+                            and n_tok >= disconnect_after[i]):
+                        # vanish abruptly: linger-0 close sends a genuine
+                        # RST mid-stream (the fault the gateway must turn
+                        # into Router.cancel)
+                        s.setsockopt(socket_mod.SOL_SOCKET,
+                                     socket_mod.SO_LINGER,
+                                     struct.pack("ii", 1, 0))
+                        out["disconnected_at"] = n_tok
+                        return
+                    if b"event: done" in data and data.endswith(b"\n\n"):
+                        break
+                for block in data.split(b"\n\n"):
+                    if b"event: done" in block:
+                        for line in block.splitlines():
+                            if line.startswith(b"data: "):
+                                out["done"] = json.loads(line[6:])
+                if out.get("status_code") not in (None, 200):
+                    # rejected (429/503): body is one JSON document
+                    try:
+                        out["rejected"] = json.loads(data.decode())
+                    except ValueError:
+                        pass
+            finally:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in sorted(prompts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=420.0)
+        assert not any(t.is_alive() for t in threads), "client threads hung"
+
+        # -- wait out the upgrade + all terminals -------------------------
+        deadline = time.monotonic() + 300.0
+        accepted = {out["uid"]: i for i, out in outcomes.items()
+                    if out.get("uid") is not None}
+        while True:
+            st = router.upgrade_status()
+            done = (st is not None and st["state"] != "running"
+                    and all(router.result(u) is not None for u in accepted)
+                    and not any(s == "draining"
+                                for s in router.replica_states().values()))
+            if done:
+                break
+            assert time.monotonic() < deadline, (
+                "drill wall-clock cap exceeded",
+                st, router.replica_states())
+            time.sleep(0.1)
+
+        # stop the serve loop BEFORE asserting: the RPC sockets are owned
+        # by the loop thread, and the direct compile_counts/prefix-stats
+        # calls below would otherwise interleave frames with its steps
+        gw.stop()
+
+        # -- the front-door contract, asserted ----------------------------
+        assert state["killed_slot"] is not None, "the SIGKILL never fired"
+        assert state["respawns"] >= 1, "the corpse was never recovered"
+        # zero accepted-request loss: every uid the gateway accepted is
+        # terminal; disconnected streams terminate cancelled
+        missing = [u for u in accepted if router.result(u) is None]
+        assert not missing, f"accepted uids without a terminal state: {missing}"
+        statuses = {u: router.result(u).status for u in accepted}
+        disconnected_uids = [outcomes[i]["uid"] for i in disconnect_after
+                             if outcomes[i].get("uid") is not None
+                             and "disconnected_at" in outcomes[i]]
+        assert disconnected_uids, "no mid-stream disconnect happened"
+        cancelled = [u for u in disconnected_uids
+                     if statuses[u] == "cancelled"]
+        assert cancelled, (
+            "no vanished reader was cancelled fleet-side", statuses)
+        # bitwise greedy parity on completed requests vs the unfaulted run
+        parity_checked = 0
+        for u, i in accepted.items():
+            res = router.result(u)
+            if res.status != "ok":
+                continue
+            np.testing.assert_array_equal(
+                res.tokens, ref[i],
+                err_msg=f"uid {u} (client {i}) diverged from the "
+                        f"unfaulted run")
+            done_ev = outcomes[i].get("done")
+            if done_ev is not None:
+                assert done_ev["tokens"] == [int(t) for t in ref[i]], (
+                    "SSE-streamed tokens diverged", i)
+            parity_checked += 1
+        assert parity_checked >= 6, (
+            f"only {parity_checked} completed requests to compare",
+            statuses)
+        # the rolling upgrade replaced every generation under traffic
+        st = router.upgrade_status()
+        assert st["state"] == "done", st
+        upgraded = [w for w in st["waves"] if w.get("outcome") == "upgraded"]
+        assert len(upgraded) >= 3, st
+        # slot + prefix-ref occupancy back to 0 on every live replica;
+        # watchdog RAISE held (ONE decode program per reachable worker)
+        live = [r for r in router._replicas if r.state == "healthy"]
+        assert live, router.replica_states()
+        for r in live:
+            assert r.engine.load == 0, (r.rid, r.engine.load)
+            # raise-mode held: ONE decode program ever (a post-upgrade
+            # rookie that saw no traffic has 0 — never 2)
+            assert r.engine.compile_counts()["decode"] <= 1, r.rid
+            pstats = r.engine.prefix_cache_stats()
+            leaked = [e for e in (pstats or {}).get("entries", [])
+                      if e.get("refs")]
+            assert not leaked, (r.rid, leaked)
+
+        snap = gw.telemetry_snapshot()
+        counters = snap["router"]["metrics"]["counters"]
+        gw_c = {k.split("/", 1)[1]: int(v) for k, v in counters.items()
+                if k.startswith("gateway/")}
+
+        from collections import Counter as _Counter
+
+        print(json.dumps({
+            "metric": "gateway chaos drill (disconnects+kill+upgrade survived)",
+            "value": int(len(cancelled) + state["respawns"]
+                         + len(upgraded)),
+            "unit": "events",
+            # CPU-pinned correctness soak: never a trajectory datapoint
+            **_drill_stamp(),
+            "workers": 3,
+            "transport": "tcp",
+            "n_requests": n_req,
+            "accepted": len(accepted),
+            "rejected_at_submit": len([o for o in outcomes.values()
+                                       if o.get("status_code", 200) != 200]),
+            "statuses": dict(_Counter(statuses.values())),
+            "disconnects": len(disconnected_uids),
+            "cancelled_on_disconnect": len(cancelled),
+            "respawns": state["respawns"],
+            "upgrade_waves": len(upgraded),
+            "greedy_bitwise_match_ok_set": True,
+            "parity_checked": parity_checked,
+            "gateway": gw_c,
+            "seed": seed,
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+        }), flush=True)
+        return 0
+    finally:
+        sup.shutdown()
+
+
+def _drill_stamp():
+    """The constant provenance block every CPU-pinned correctness drill
+    stamps into its row: the ``_stamp_row`` platform/comparable/perf-xray
+    contract (labeled, never rated) — one definition so a drill can't
+    drift from the trajectory tooling's expectations."""
+    return {
+        "platform": "cpu",
+        "comparable": False,
+        "mfu": None,
+        "roofline": "unrated:cpu",
+        "step_anatomy": None,
+    }
 
 
 def _stamp_row(obj, stage):
@@ -1149,6 +1491,23 @@ if __name__ == "__main__":
                   f"[--surge-seed <int>] ({e})", file=sys.stderr)
             sys.exit(2)
         sys.exit(_surge(n_requests, surge_seed))
+    if "--gateway-chaos" in sys.argv:
+        # usage-error exit 2 on malformed values (same contract as
+        # --chaos/--chaos-serving/--surge)
+        try:
+            idx = sys.argv.index("--gateway-chaos")
+            if idx + 1 < len(sys.argv) and not sys.argv[idx + 1].startswith("--"):
+                raise ValueError(
+                    f"unexpected operand {sys.argv[idx + 1]!r} (the drill "
+                    "takes only --gateway-seed)")
+            gw_seed = 0
+            if "--gateway-seed" in sys.argv:
+                gw_seed = int(sys.argv[sys.argv.index("--gateway-seed") + 1])
+        except (IndexError, ValueError) as e:
+            print(f"usage: bench.py --gateway-chaos [--gateway-seed <int>] "
+                  f"({e})", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_gateway_chaos(gw_seed))
     if "--chaos-serving" in sys.argv:
         # usage-error exit 2 on malformed values (same contract as --chaos)
         try:

@@ -69,6 +69,7 @@ Deployment models — the SAME Router state machine drives both:
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field, replace
@@ -107,6 +108,8 @@ class _Replica:
     completed: int = 0       # terminal results recorded from this replica
     last_step_sec: float = 0.0  # latest non-compiling step latency
     #                           (the autoscaler's saturation signal)
+    ok_steps: int = 0        # completed non-compiling steps — the rolling
+    #                          upgrade's "newcomer proven healthy" gate
 
     @property
     def accepts(self) -> bool:
@@ -234,6 +237,12 @@ class Router:
         self._brownout = False
         self._brownout_deadline_s = 0.0
         self._autoscaler = None
+        # rolling-upgrade state machine (docs/serving.md "HTTP front door
+        # & rolling upgrades"); ticked by step() while one is in progress
+        self._upgrade: Optional[_RollingUpgrade] = None
+        # set by enable_stream_progress (an SSE gateway exists): remote
+        # replicas piggyback tokens-so-far on step replies
+        self._stream_progress = False
         self.telemetry.gauge("router/replicas").set(rc.replicas)
         self._update_gauges()
         log_dist(
@@ -381,6 +390,41 @@ class Router:
         """Seconds on the fleet clock (the epoch every replica is anchored
         to) — arrival times, deadlines and autoscale cooldowns all read it."""
         return time.perf_counter() - self._epoch
+
+    def enable_stream_progress(self) -> None:
+        """Ask remote replicas to piggyback tokens-so-far on every step
+        reply (the ``partial_result`` feed for SSE streaming). OPT-IN
+        because the piggyback re-sends each live stream's full token list
+        per step — a fleet with no streaming front door must not pay that
+        wire cost. The HTTP gateway flips this at construction; replicas
+        attached later inherit it. In-process replicas need nothing (the
+        scheduler's slot state is read directly)."""
+        self._stream_progress = True
+        for r in self._replicas:
+            if hasattr(r.engine, "stream_progress"):
+                r.engine.stream_progress = True
+
+    def partial_result(self, uid: int):
+        """Incremental per-uid result surface — what the SSE gateway
+        streams from (launcher/http_gateway.py): ``(tokens_so_far,
+        terminal_result_or_None)``, or None for a uid the fleet does not
+        hold. Host-cache reads only (an in-process replica's slot state, a
+        remote replica's step-piggybacked progress cache) — polling this
+        per streaming client per step costs zero device work and zero
+        extra round trips. After a failover the replay re-decodes from
+        scratch, so ``tokens_so_far`` may transiently shrink; greedy
+        replays re-produce the identical prefix, and the terminal result
+        is always authoritative."""
+        res = self._results.get(uid)
+        if res is not None:
+            return np.asarray(res.tokens, np.int32), res
+        rid = self._owner.get(uid)
+        if rid is None:
+            return None
+        toks = self._replicas[rid].engine.partial_tokens(uid)
+        if toks is None:
+            toks = np.zeros((0,), np.int32)
+        return np.asarray(toks, np.int32), None
 
     # -- overload brownout (docs/serving.md "Elastic fleet & brownout") --
 
@@ -717,6 +761,11 @@ class Router:
                     and latency > self.health.timeout):
                 self._fail(r, "hung", now, terminal)
                 continue
+            if not compiled:
+                # the rolling upgrade's newcomer gate counts only steps
+                # that survived the hung verdict — a step that overran
+                # health.timeout must not "prove" a newcomer healthy
+                r.ok_steps += 1
             if r.state == "draining" and r.engine.idle:
                 r.state = "drained"
                 tm.counter("router/replicas_drained").inc()
@@ -726,11 +775,16 @@ class Router:
         tm.gauge("router/queue_depth").set(
             sum(r.engine.queue_len for r in self._replicas if r.stepped))
         self._update_gauges()
-        if self._autoscaler is not None:
+        if self._upgrade is not None and self._upgrade.state == "running":
+            self._upgrade.tick(now)
+        elif self._autoscaler is not None:
             # the elasticity loop closes here: every fleet step evaluates
             # the scaling signals. Worker-process boots run on a
             # background thread (a later tick attaches the new replica),
-            # so the fleet never stops stepping while one boots
+            # so the fleet never stops stepping while one boots.
+            # Autoscale evaluation PAUSES while a rolling upgrade runs —
+            # the upgrade churns membership deliberately, and the signals
+            # would misread the transient double-capacity as idleness
             self._autoscaler.tick(now)
         return terminal
 
@@ -873,6 +927,10 @@ class Router:
         if hasattr(engine, "bind_telemetry"):
             engine.bind_telemetry(self.telemetry)
         engine.set_epoch(self._epoch)
+        if self._stream_progress and hasattr(engine, "stream_progress"):
+            # a streaming front door is attached: joiners piggyback
+            # tokens-so-far like the rest of the fleet
+            engine.stream_progress = True
         self._replicas.append(_Replica(rid, engine))
         self.telemetry.gauge("router/replicas").set(len(self._replicas))
         self.telemetry.counter("router/replicas_attached").inc()
@@ -881,11 +939,66 @@ class Router:
                  f"({len(self._accepting())} accepting dispatch)", ranks=[0])
         return rid
 
+    # -- rolling upgrades -------------------------------------------------
+
+    def rolling_upgrade(self, *, supervisor=None, slots: dict | None = None,
+                        spawn=None, spec: dict | None = None,
+                        gate_timeout_s: float = 120.0) -> None:
+        """Begin a zero-downtime worker-by-worker fleet upgrade
+        (docs/serving.md "HTTP front door & rolling upgrades"). For each
+        replica that was healthy when the upgrade started, one WAVE:
+
+          1. boot the NEW generation first (``supervisor.spawn`` on a
+             background thread — the fleet keeps stepping — or the
+             ``spawn`` callable / the in-process builder, run inline),
+          2. ``attach_replica`` it and GATE on its first healthy
+             non-compiling step (a newcomer that dies, hangs, or never
+             completes a clean step within ``gate_timeout_s`` ABORTS the
+             upgrade — the old generation keeps serving, the failed
+             newcomer is drained and its worker retired). KNOWN LIMIT:
+             during a traffic lull the gating step may be an idle one —
+             it proves the newcomer booted its engine and answers the
+             scheduler surface, not that it can serve load; a spec that
+             only fails under real work passes the gate (a canary
+             request per wave is the future strengthening),
+          3. only then ``drain_replica`` the old generation (queued work
+             migrates, in-flight streams finish in place — zero accepted
+             requests lost) and retire its worker slot.
+
+        ``supervisor``/``slots`` mirror the Autoscaler's contract:
+        ``slots`` maps already-attached rids to their supervisor slots;
+        newcomers take fresh slots. ``spec`` (with a supervisor) installs
+        the new generation's engine spec via ``WorkerSupervisor.set_spec``
+        before the first boot — running workers keep the old generation's
+        spec until their wave replaces them. The state machine is ticked
+        by ``step()``; poll ``upgrade_status()``. Autoscale evaluation
+        pauses for the duration."""
+        if self._upgrade is not None and self._upgrade.state == "running":
+            raise ValueError("a rolling upgrade is already in progress")
+        self.telemetry.counter("router/upgrades").inc()
+        self._upgrade = _RollingUpgrade(
+            self, supervisor=supervisor, slots=slots, spawn=spawn,
+            spec=spec, gate_timeout_s=gate_timeout_s)
+        log_dist(
+            f"router: rolling upgrade started over replicas "
+            f"{self._upgrade.plan} (gate: first healthy non-compiling "
+            f"step, {gate_timeout_s}s timeout)", ranks=[0])
+
+    def upgrade_status(self) -> Optional[dict]:
+        """State of the current/last rolling upgrade (None if never
+        started): ``{state, waves, pending, slots}``."""
+        return None if self._upgrade is None else self._upgrade.status()
+
     # -- observability ---------------------------------------------------
 
     @property
     def results(self) -> dict[int, RequestResult]:
         return dict(self._results)
+
+    def result(self, uid: int) -> Optional[RequestResult]:
+        """The terminal result for ``uid`` (None while in flight) — the
+        O(1) accessor; the ``results`` property copies the whole map."""
+        return self._results.get(uid)
 
     def owner_of(self, uid: int) -> Optional[int]:
         """Replica id currently holding live request ``uid`` (None once
@@ -953,8 +1066,238 @@ class Router:
                    if self.tracer is not None else {}),
                 **({"autoscale": self._autoscaler.describe()}
                    if self._autoscaler is not None else {}),
+                **({"upgrade": self._upgrade.status()}
+                   if self._upgrade is not None else {}),
             },
             "replicas": reps,
         }
         self.telemetry.emit({"type": "snapshot", **snap})
         return snap
+
+
+class _RollingUpgrade:
+    """Worker-by-worker generation replacement, as a state machine ticked
+    by ``Router.step()`` — the upgrade must never stall the serve loop
+    (clients are streaming tokens while it runs). One wave per replica
+    that was healthy at start; within a wave the phases are
+
+        boot -> gate -> drain        (success: old generation retired)
+                  \\-> abort_drain    (failure: NEWCOMER drained/retired,
+                                      old generation keeps serving, the
+                                      whole upgrade stops)
+
+    The gate is the newcomer's first healthy NON-COMPILING step
+    (``_Replica.ok_steps``): a replacement that boots but cannot serve —
+    crashes on its first step, hangs, or compiles forever — must never
+    cost the fleet its proven old generation. Supervisor boots run on a
+    background thread (the autoscaler's discipline); in-process builds run
+    inline (same XLA programs — a cache hit, not a compile)."""
+
+    def __init__(self, router: Router, *, supervisor=None,
+                 slots: dict | None = None, spawn=None,
+                 spec: dict | None = None, gate_timeout_s: float = 120.0):
+        self.router = router
+        self.supervisor = supervisor
+        self.slots: dict[int, int] = dict(slots or {})  # rid -> slot
+        self._spawn_fn = spawn
+        self.gate_timeout_s = float(gate_timeout_s)
+        self.state = "running"
+        self.reason = ""
+        self.plan: list[int] = [r.rid for r in router._replicas
+                                if r.state == "healthy"]
+        self.waves: list[dict] = []
+        self._wave: Optional[dict] = None
+        self._boot: Optional[dict] = None
+        self._next_slot = max(self.slots.values(), default=-1) + 1
+        asc = router._autoscaler
+        if asc is not None:
+            # a bound autoscaler owns the SAME slot namespace: newcomer
+            # slots must not collide with ones it may allocate later, and
+            # its rid->slot ledger must track every wave (a stale ledger
+            # would make a post-upgrade scale-up spawn onto a live
+            # worker's slot, and scale-down retirements silently no-op)
+            self._next_slot = max(self._next_slot, asc._slot_seq)
+        if supervisor is not None and spec is not None:
+            # the new generation's spec: running workers keep the old one
+            # until their wave's retire->spawn replaces them
+            supervisor.set_spec(spec)
+
+    def _ledger_attach(self, rid: int, slot) -> None:
+        """Record a newcomer in this upgrade's map AND the autoscaler's."""
+        if slot is None:
+            return
+        self.slots[rid] = slot
+        asc = self.router._autoscaler
+        if asc is not None:
+            asc._slots[rid] = slot
+            asc._slot_seq = max(asc._slot_seq, slot + 1)
+
+    def _ledger_retire(self, rid: int):
+        """Drop ``rid`` from both maps; returns its slot (or None)."""
+        slot = self.slots.pop(rid, None)
+        asc = self.router._autoscaler
+        if asc is not None:
+            asc._slots.pop(rid, None)
+        return slot
+
+    # -- boots ------------------------------------------------------------
+
+    def _begin_boot(self) -> None:
+        holder: dict = {"slot": None, "result": None, "error": None,
+                        "thread": None}
+        if self.supervisor is None:
+            # in-process replacement: same engine + config => the build is
+            # an XLA cache hit, cheap enough to run inline (and jit state
+            # is not guaranteed thread-safe to mutate off the serve loop)
+            try:
+                holder["result"] = (self._spawn_fn() if self._spawn_fn
+                                    else self.router._spawn_inprocess())
+            except (RpcError, OSError, RuntimeError) as e:
+                holder["error"] = e
+            self._boot = holder
+            return
+        slot = self._next_slot
+        self._next_slot += 1
+        holder["slot"] = slot
+
+        def run():
+            try:
+                holder["result"] = self.supervisor.spawn(slot)
+            except (RpcError, OSError, RuntimeError) as e:
+                holder["error"] = e
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"dstpu-upgrade-boot-{slot}")
+        holder["thread"] = t
+        self._boot = holder
+        t.start()
+
+    # -- the tick ----------------------------------------------------------
+
+    def tick(self, now: float) -> None:
+        if self.state != "running":
+            return
+        if self._wave is None:
+            if not self.plan:
+                self.state = "done"
+                log_dist(
+                    f"router: rolling upgrade complete "
+                    f"({len([w for w in self.waves if w.get('outcome') == 'upgraded'])} "
+                    f"replicas replaced)", ranks=[0])
+                return
+            old = self.plan.pop(0)
+            if self.router._replicas[old].state != "healthy":
+                # died or was drained since the plan snapshot — nothing
+                # left to upgrade in this wave
+                self.waves.append({"old_rid": old, "outcome": "skipped"})
+                return
+            self._wave = {"old_rid": old, "new_rid": None, "phase": "boot",
+                          "started": round(now, 4)}
+            self._begin_boot()
+            return
+        w = self._wave
+        if w["phase"] == "boot":
+            b = self._boot
+            if b["thread"] is not None and b["thread"].is_alive():
+                return  # still booting; the fleet keeps stepping
+            if b["error"] is not None:
+                self._abort(now, "newcomer boot failed: "
+                            f"{type(b['error']).__name__}: {b['error']}",
+                            boot_slot=b["slot"])
+                return
+            new_rid = self.router.attach_replica(b["result"])
+            self._ledger_attach(new_rid, b["slot"])
+            w["new_rid"] = new_rid
+            w["phase"] = "gate"
+            w["gate_start"] = now
+            return
+        if w["phase"] == "gate":
+            new_r = self.router._replicas[w["new_rid"]]
+            if new_r.state == "dead":
+                self._abort(now, f"newcomer replica {w['new_rid']} died "
+                            "before its first healthy step")
+                return
+            if new_r.state == "healthy" and new_r.ok_steps >= 1:
+                # newcomer proven: NOW the old generation may go
+                self.router.drain_replica(w["old_rid"], block=False)
+                w["phase"] = "drain"
+                return
+            if now - w["gate_start"] > self.gate_timeout_s:
+                self._abort(now, f"newcomer replica {w['new_rid']} never "
+                            "completed a healthy non-compiling step within "
+                            f"{self.gate_timeout_s}s")
+            return
+        if w["phase"] in ("drain", "abort_drain"):
+            rid = w["old_rid"] if w["phase"] == "drain" else w["new_rid"]
+            if self.router._replicas[rid].state == "draining":
+                return
+            # drained — or dead, in which case the router already failed
+            # its in-flight work over; either way the worker can go
+            self._retire_slot(self._ledger_retire(rid))
+            if w["phase"] == "drain":
+                w["outcome"] = "upgraded"
+                self.router.telemetry.counter("router/upgrade_waves").inc()
+                log_dist(
+                    f"router: upgrade wave done — replica {w['old_rid']} "
+                    f"retired, replica {w['new_rid']} serving", ranks=[0])
+                self.waves.append(w)
+                self._wave = None
+            else:
+                w["outcome"] = "aborted"
+                self.waves.append(w)
+                self._wave = None
+                self.state = "aborted"
+
+    def _retire_slot(self, slot) -> None:
+        """Retire a worker slot WITHOUT stalling the serve loop:
+        ``WorkerSupervisor.retire`` SIGTERMs then ``proc.wait``s up to its
+        timeout, and a slow-to-exit old generation must not freeze every
+        client's token stream for that long (the same discipline that put
+        boots on background threads). Fire-and-forget is safe: the
+        replica is already drained/dead, so nothing routes to it."""
+        if slot is None or self.supervisor is None:
+            return
+
+        def run():
+            try:
+                self.supervisor.retire(slot)
+            except OSError:  # a corpse's slot: reaping is best-effort
+                pass
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"dstpu-upgrade-retire-{slot}").start()
+
+    def _abort(self, now: float, reason: str, boot_slot=None) -> None:
+        """Keep the OLD generation serving. A failed-boot newcomer only
+        needs its slot reaped; an attached-but-unproven one is drained
+        first (dispatch may already have routed arrivals to it — zero
+        accepted requests lost even on the abort path)."""
+        self.reason = reason
+        self.router.telemetry.counter("router/upgrade_aborts").inc()
+        log_dist(f"router: rolling upgrade ABORTED — {reason} (old "
+                 "generation keeps serving)", ranks=[0])
+        w = self._wave
+        self._retire_slot(boot_slot)
+        new_rid = w.get("new_rid") if w else None
+        if new_rid is not None and \
+                self.router._replicas[new_rid].state == "healthy":
+            self.router.drain_replica(new_rid, block=False)
+            w["phase"] = "abort_drain"
+            return
+        if new_rid is not None:
+            self._retire_slot(self._ledger_retire(new_rid))
+        if w is not None:
+            w["outcome"] = "aborted"
+            self.waves.append(w)
+        self._wave = None
+        self.state = "aborted"
+
+    def status(self) -> dict:
+        return {
+            "state": self.state,
+            "reason": self.reason,
+            "pending": list(self.plan),
+            "current": dict(self._wave) if self._wave else None,
+            "waves": [dict(w) for w in self.waves],
+            "slots": dict(self.slots),
+        }

@@ -611,6 +611,13 @@ class ReplicaClient:
         self._results: dict[int, object] = {}  # uid -> decoded RequestResult
         self._trace_flush: deque = deque(maxlen=4096)
         self._ack: list[int] = []  # terminal uids to acknowledge next step
+        # per-uid tokens-so-far, refreshed whole by every step reply — the
+        # gateway's SSE streams read this cache (partial_tokens), so token
+        # streaming costs ZERO extra round trips. OPT-IN: the block is
+        # only requested while ``stream_progress`` is set (a streaming
+        # front door exists); other fleets skip the O(tokens^2) wire cost
+        self.stream_progress = False
+        self._progress: dict[int, list[int]] = {}
 
     # -- connection / identity ------------------------------------------
 
@@ -697,13 +704,16 @@ class ReplicaClient:
              enforce_deadlines: bool = True) -> list[int]:
         reply = self.rpc.call(
             "step", now=now, enforce_deadlines=bool(enforce_deadlines),
-            ack=self._ack, retry_safe=True)
+            ack=self._ack, progress=bool(self.stream_progress),
+            retry_safe=True)
         self._ack = []
         self._refresh(reply)
         self._compiled = bool(reply.get("compiled"))
         for k, enc in (reply.get("results") or {}).items():
             self._results[int(k)] = decode_result(enc)
         self._trace_flush.extend(reply.get("trace") or [])
+        self._progress = {int(k): [int(t) for t in v]
+                          for k, v in (reply.get("progress") or {}).items()}
         uids = [int(u) for u in reply.get("uids") or []]
         self._ack = list(uids)
         return uids
@@ -768,6 +778,22 @@ class ReplicaClient:
         while self._trace_flush and len(out) < limit:
             out.append(self._trace_flush.popleft())
         return out
+
+    def partial_tokens(self, uid: int):
+        """Tokens-so-far for ``uid``, served from the step-piggybacked
+        progress cache (plus terminal results) — NEVER the wire: a
+        gateway polls this per streaming client per step, and an extra
+        RPC per poll would multiply transport load by the stream count.
+        None when the worker has not reported the uid (it may still be
+        queued remotely: the caller treats None as no-progress-yet)."""
+        uid = int(uid)
+        res = self._results.get(uid)
+        if res is not None:
+            return np.asarray(res.tokens, np.int32)
+        toks = self._progress.get(uid)
+        if toks is None:
+            return None
+        return np.asarray(toks, np.int32)
 
     # -- observability ---------------------------------------------------
 

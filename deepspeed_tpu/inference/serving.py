@@ -652,6 +652,7 @@ class ServingEngine:
                  fault_injection: FaultInjectionConfig | dict | None = None):
         config = dict(config or {})
         config.pop("router", None)  # the Router's block, not this engine's
+        config.pop("gateway", None)  # the HTTP front door's block
         n_slots = n_slots if n_slots is not None else config.get("n_slots", 8)
         max_seq_len = max_seq_len if max_seq_len is not None else config.get(
             "max_seq_len", 0)
@@ -920,6 +921,35 @@ class ServingEngine:
     def result(self, uid: int) -> Optional[RequestResult]:
         """The terminal result for ``uid``, or None while in flight."""
         return self._results.get(uid)
+
+    def partial_tokens(self, uid: int) -> Optional[np.ndarray]:
+        """Tokens generated SO FAR for ``uid`` — the incremental result
+        surface an SSE gateway streams from (launcher/http_gateway.py):
+        the decoding slot's token list, an empty array for a request still
+        queued or mid-prefill, or the terminal result's tokens. None for a
+        uid this engine does not hold. Pure host reads — no device work,
+        no new programs; tokens already crossed to the host in step()."""
+        res = self._results.get(uid)
+        if res is not None:
+            return np.asarray(res.tokens, np.int32)
+        for slot in range(self.n_slots):
+            st = self._slots[slot]
+            if self._active[slot] and st.uid == uid:
+                return np.asarray(st.tokens, np.int32)
+        if (any(r.uid == uid for r in self._queue)
+                or any(pf.req.uid == uid
+                       for pf in self._prefilling.values())):
+            return np.zeros((0,), np.int32)
+        return None
+
+    def live_progress(self) -> dict[int, list[int]]:
+        """``{uid: tokens-so-far}`` for every ACTIVE (decoding) slot — the
+        per-step progress block a worker process piggybacks on its step
+        reply so a remote gateway's streams advance with ZERO extra round
+        trips (rpc.ReplicaClient caches it like load/idle)."""
+        return {st.uid: list(map(int, st.tokens))
+                for slot, st in enumerate(self._slots)
+                if self._active[slot] and st.uid >= 0}
 
     def live_requests(self) -> list[Request]:
         """Accepted, non-terminal requests in scheduler order (queued, then

@@ -1,0 +1,789 @@
+"""HTTP/SSE front door: the serving fleet behind a real socket.
+
+Every fleet proof so far drove ``Router.submit()`` from inside the
+process; "millions of users" means the deadline/shed/quarantine (PR 4),
+failover (PR 6/8), and brownout/priority (PR 11) machinery must be
+reachable — and survivable — from the network. ``HttpGateway`` is a
+stdlib-only (``http.server`` + ``threading``) HTTP/1.1 server in front of
+one ``Router``:
+
+  * ``POST /v1/generate``  — JSON body ``{"prompt": [ints],
+    "max_new_tokens", "temperature", "top_k", "top_p", "eos_token",
+    "stream"}``; per-request ``X-DSTPU-Priority`` and
+    ``X-DSTPU-Deadline-S`` headers map onto ``Request.priority`` /
+    ``Request.deadline_s`` — the brownout ladder and the deadline sweeps
+    see HTTP traffic exactly as they see in-process submits. With
+    ``stream`` (the default) the response is Server-Sent Events: one
+    ``token`` event per generated token off the Router's incremental
+    ``partial_result`` surface, then one ``done`` event carrying the
+    authoritative terminal result. ``"stream": false`` waits and returns
+    one JSON document.
+  * overload → HTTP semantics — typed ``RequestRejected`` reasons map to
+    distinct statuses: ``queue_full``/``overloaded`` → 429 (brownout's
+    ``overloaded`` tells clients to back off; both carry ``Retry-After``
+    derived from the autoscaler's cooldown — the earliest instant more
+    capacity could exist), ``no_healthy_replicas`` → 503, malformed
+    bodies / budget violations → 400, oversized bodies → 413.
+  * client disconnect → ``Router.cancel`` — a vanished or stalled reader
+    is detected by the stream's next write (token events, or the ~1s
+    keepalive comments an idle stream emits exactly so detection is
+    bounded) failing or overrunning ``gateway.write_timeout_s``; the
+    gateway cancels the uid, which frees its slot and prefix refs
+    (occupancy returns to 0 — the ``bench.py --gateway-chaos`` proof).
+  * ``GET /healthz`` — 200 while serving (healthy-replica count, open
+    streams, brownout flag), 503 once draining or with no healthy
+    replica: the load-balancer-facing signal to stop sending traffic.
+  * ``GET /metrics`` — the fleet registry (``router/*``, ``gateway/*``,
+    per the shared telemetry bundle) as Prometheus text.
+  * SIGTERM → drain — ``run()`` installs ``resilience/preemption.
+    PreemptionGuard``; on the flag the gateway stops accepting (new
+    submits get 503 ``shutting_down``), finishes every in-flight stream
+    (bounded by ``shutdown_grace_s``), drains the loop, and returns 0 —
+    the same discipline as ``launcher/serving_worker``.
+
+Threading model — the Router is NOT thread-safe, so exactly ONE thread
+(the serve loop, ``run()``'s caller or ``start()``'s daemon) ever touches
+it: handler threads talk to the loop through a command queue (submit /
+cancel, each with a reply event) and read per-stream token feeds the loop
+publishes after every ``Router.step()``. Feeds are filled from
+``Router.partial_result`` — host-cache reads only (a worker process
+piggybacks tokens-so-far on its step replies), so N streaming clients
+cost zero extra RPCs. ``on_tick`` runs on the loop thread each iteration:
+chaos drills do their supervision (corpse respawn, rolling-upgrade
+kickoff) there so fleet membership is only ever mutated by the owning
+thread.
+
+Fault sites (``resilience/faults.py``): ``gateway_disconnect`` makes the
+stream's write path observe a vanished client after the Nth token;
+``gateway_stall`` simulates a reader that stops draining its socket (the
+send overruns the write deadline). Both must land in the SAME
+disconnect→cancel containment path the real events take.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+import time
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from ..resilience import FaultInjector, RequestRejected
+from ..resilience.preemption import PreemptionGuard
+from ..runtime.config import FaultInjectionConfig, GatewayConfig
+from ..telemetry import RequestTracer, prometheus_text
+from ..utils.logging import log_dist
+
+# RequestRejected reason -> HTTP status. 429 = the CLIENT should back off
+# and retry (capacity exists or is being added); 503 = the fleet itself
+# cannot serve (no healthy replica / shutting down).
+_REASON_STATUS = {
+    "queue_full": 429,
+    "overloaded": 429,
+    "no_healthy_replicas": 503,
+    "shutting_down": 503,
+}
+
+
+class _Stream:
+    """One accepted request's token feed: the serve loop appends, the
+    handler thread drains. ``tokens`` is the authoritative so-far list
+    (replays after a failover may rewrite it; the handler only ever reads
+    the suffix past what it already sent, and greedy replays re-produce
+    the identical prefix)."""
+
+    def __init__(self, uid: int):
+        self.uid = uid
+        self.cond = threading.Condition()
+        self.tokens: list[int] = []
+        self.result = None  # terminal RequestResult once done
+        self.done = False
+
+    def publish(self, tokens, result) -> None:
+        """Serve-loop side: replace the token view, mark terminal."""
+        with self.cond:
+            if tokens is not None:
+                self.tokens = [int(t) for t in tokens]
+            if result is not None:
+                self.result = result
+                self.done = True
+            self.cond.notify_all()
+
+    def fail(self) -> None:
+        """Terminally fail the feed with NO result (the fleet forgot the
+        uid, or the loop is going down) — the handler replies/closes
+        instead of waiting on tokens that can never come."""
+        with self.cond:
+            self.done = True
+            self.cond.notify_all()
+
+
+class HttpGateway:
+    """One ``Router`` behind an HTTP/1.1 + SSE front door (see module
+    docstring). ``config`` is a ``GatewayConfig``, a dict with the same
+    keys (the ``serving.gateway`` schema), or None for defaults.
+
+    Metrics land in the ROUTER's telemetry bundle under ``gateway/*`` (one
+    fleet registry, one ``/metrics`` answer); per-request gateway stages
+    (``http_accepted`` / ``stream_started`` / ``client_disconnected`` /
+    ``stream_done``) are recorded by the gateway's own ``RequestTracer``
+    stamped ``gateway<id>`` on the router's clock, merged by
+    ``telemetry/request_trace.request_timeline``.
+    """
+
+    def __init__(self, router, config: GatewayConfig | dict | None = None,
+                 *, gateway_id: int | str = 0,
+                 fault_injection: FaultInjectionConfig | dict | None = None,
+                 on_tick=None):
+        if config is None:
+            config = GatewayConfig()
+        elif isinstance(config, dict):
+            config = GatewayConfig(**config)
+        self.cfg: GatewayConfig = config
+        self.router = router
+        self.gateway_id = gateway_id
+        self.telemetry = router.telemetry
+        self.tracer = RequestTracer(
+            2048, replica_id=f"gateway{gateway_id}", clock=router.now)
+        if fault_injection is not None and not isinstance(
+                fault_injection, FaultInjector):
+            fault_injection = FaultInjector(fault_injection)
+        self._inj: Optional[FaultInjector] = (
+            fault_injection if (fault_injection is not None
+                                and fault_injection.enabled) else None)
+        self._on_tick = on_tick
+        self._cmds: queue.Queue = queue.Queue()
+        self._streams: dict[int, _Stream] = {}
+        self._lock = threading.Lock()  # guards _streams / flags below
+        # uid namespace: gateway_id picks a 2^32-wide band (uids are
+        # gid<<32 + n), so two gateways with distinct ids in front of one
+        # Router can never collide — a collision would surface as a bogus
+        # 400 blaming the client's request. String ids hash into a band
+        # DISJOINT from numeric ones (bit 16 set), so a mixed int/str
+        # fleet cannot alias either. NOTE: the DEFAULT id 0 is band 0 —
+        # code that also submits its own small uids directly to the same
+        # Router must give the gateway a nonzero id
+        gid = (int(gateway_id)
+               if str(gateway_id).isdigit() and int(gateway_id) < 0x10000
+               else 0x10000 | (zlib.crc32(str(gateway_id).encode()) & 0xFFFF))
+        self._uid = gid << 32
+        self._draining = False
+        self._stopped = False
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._loop_thread: Optional[threading.Thread] = None
+        self._guard: Optional[PreemptionGuard] = None
+        # remote replicas piggyback tokens-so-far on step replies only
+        # while a streaming front door exists — this gateway is one
+        # (guarded: test fakes implement only the surface they exercise)
+        enable = getattr(router, "enable_stream_progress", None)
+        if enable is not None:
+            enable()
+        self.telemetry.gauge("gateway/open_streams").set(0)
+        self.telemetry.gauge("gateway/draining").set(0)
+
+    # -- lifecycle --------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else 0
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.cfg.host}:{self.port}"
+
+    def _bind(self) -> None:
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer(
+            (self.cfg.host, self.cfg.port), handler)
+        self._httpd.daemon_threads = True
+        self._httpd.timeout = 1.0
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True, name=f"dstpu-gw-http-{self.gateway_id}")
+        self._http_thread.start()
+        log_dist(f"gateway {self.gateway_id}: listening on {self.address}",
+                 ranks=[0])
+
+    def start(self) -> None:
+        """Bind and serve from a daemon loop thread (tests/drills; no
+        signal handling — use ``trigger_shutdown()`` / ``stop()``)."""
+        self._bind()
+        self._loop_thread = threading.Thread(
+            target=self._serve_loop, daemon=True,
+            name=f"dstpu-gw-loop-{self.gateway_id}")
+        self._loop_thread.start()
+
+    def run(self) -> int:
+        """Bind and serve on THIS thread until SIGTERM/SIGINT, then drain
+        and return 0 — the process-entry discipline (module docstring)."""
+        self._guard = PreemptionGuard(["SIGTERM", "SIGINT"])
+        self._guard.install()
+        self._bind()
+        try:
+            self._serve_loop()
+        finally:
+            self._guard.uninstall()
+        return 0
+
+    def trigger_shutdown(self) -> None:
+        """Begin the graceful drain (the SIGTERM path, callable in-process
+        by tests): stop accepting, finish in-flight streams, stop."""
+        with self._lock:
+            self._draining = True
+        self.telemetry.gauge("gateway/draining").set(1)
+
+    def stop(self) -> None:
+        """Graceful drain + join (blocking; for ``start()`` callers)."""
+        self.trigger_shutdown()
+        t = self._loop_thread
+        if t is not None:
+            t.join(timeout=max(30.0, self.cfg.shutdown_grace_s + 30.0))
+
+    def close(self) -> None:
+        """Tear the sockets down (idempotent; ``stop``/``run`` call it)."""
+        httpd = self._httpd
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=10.0)
+            self._http_thread = None
+
+    # -- the serve loop (the ONLY thread that touches the Router) ---------
+
+    def _drain_cmds(self) -> None:
+        while True:
+            try:
+                cmd = self._cmds.get_nowait()
+            except queue.Empty:
+                return
+            op = cmd["op"]
+            if cmd.get("abandoned") and op == "submit":
+                # the handler's wait deadline fired and it already replied
+                # 503 — executing the submit now would admit a request
+                # whose client was told it was refused (a leaked stream
+                # no reader will ever drain). A late CANCEL still runs:
+                # it is idempotent and frees fleet capacity either way.
+                cmd["event"].set()
+                continue
+            if op == "submit":
+                try:
+                    uid = self.router.submit(cmd["request"])
+                    stream = _Stream(uid)
+                    with self._lock:
+                        self._streams[uid] = stream
+                    cmd["stream"] = stream
+                    # stamped at the request's arrival instant: the HTTP
+                    # accept PRECEDES the fleet's arrived/dispatched edges
+                    # (equal clocks sort by stage rank)
+                    self.tracer.record(
+                        uid, "http_accepted",
+                        t=float(cmd["request"].arrival_time),
+                        priority=int(cmd["request"].priority))
+                    self.telemetry.counter("gateway/accepted").inc()
+                    if cmd.get("abandoned"):
+                        # the handler gave up DURING the submit: undo —
+                        # nobody will stream this uid. The stream is
+                        # stripped BEFORE the event is set, so the handler
+                        # sees a consistent refusal
+                        self.router.cancel(uid)
+                        self._close_stream(uid)
+                        del cmd["stream"]
+                        cmd["error"] = RequestRejected(
+                            uid, "shutting_down",
+                            "submit abandoned by its handler")
+                except (RequestRejected, ValueError) as e:
+                    cmd["error"] = e
+            elif op == "cancel":
+                cancelled = self.router.cancel(cmd["uid"])
+                if cancelled:
+                    self.telemetry.counter(
+                        "gateway/cancelled_on_disconnect").inc()
+                self._close_stream(cmd["uid"])
+            cmd["event"].set()
+
+    def _close_stream(self, uid: int) -> None:
+        with self._lock:
+            stream = self._streams.pop(uid, None)
+        if stream is not None:
+            # wake any handler still waiting so it observes the close
+            stream.publish(None, self.router.result(uid))
+        self.telemetry.gauge("gateway/open_streams").set(len(self._streams))
+
+    def _publish(self) -> None:
+        with self._lock:
+            live = list(self._streams.values())
+        for stream in live:
+            pr = self.router.partial_result(stream.uid)
+            if pr is None:
+                # the fleet no longer holds the uid (e.g. cancelled
+                # out-of-band, bypassing the gateway's cancel command) —
+                # fail the stream rather than hang its reader: a publish
+                # with no terminal result would be a no-op forever
+                res = self.router.result(stream.uid)
+                if res is not None:
+                    stream.publish(None, res)
+                else:
+                    stream.fail()
+                continue
+            tokens, result = pr
+            stream.publish(tokens, result)
+
+    def _serve_loop(self) -> None:
+        try:
+            self._serve_loop_inner()
+        finally:
+            # containment for ANY escape path (a raising on_tick hook, a
+            # Router bug): without this, handler threads would wait on
+            # feeds that can never advance and new submits would block
+            # their full command timeout against a dead loop
+            self._stopped = True
+            with self._lock:
+                streams = list(self._streams.values())
+                self._streams.clear()
+            for stream in streams:
+                stream.fail()
+            self.close()
+            log_dist(f"gateway {self.gateway_id}: drained and stopped",
+                     ranks=[0])
+
+    def _serve_loop_inner(self) -> None:
+        grace_deadline = None
+        while True:
+            if self._guard is not None and self._guard.pending():
+                self.trigger_shutdown()
+            self._drain_cmds()
+            self.router.step()
+            self._publish()
+            if self._on_tick is not None:
+                self._on_tick()
+            with self._lock:
+                draining = self._draining
+                open_streams = len(self._streams)
+            self.telemetry.gauge("gateway/open_streams").set(open_streams)
+            if draining:
+                if open_streams == 0:
+                    break
+                if grace_deadline is None and self.cfg.shutdown_grace_s > 0:
+                    grace_deadline = (time.monotonic()
+                                      + self.cfg.shutdown_grace_s)
+                if (grace_deadline is not None
+                        and time.monotonic() > grace_deadline):
+                    log_dist(
+                        f"gateway {self.gateway_id}: shutdown grace "
+                        f"({self.cfg.shutdown_grace_s}s) elapsed with "
+                        f"{open_streams} streams open — closing anyway",
+                        ranks=[0])
+                    with self._lock:
+                        uids = list(self._streams)
+                    for uid in uids:
+                        self.router.cancel(uid)
+                        self._close_stream(uid)
+                    break
+            if self.router._owner or not self._cmds.empty():
+                continue  # live work: step again immediately
+            time.sleep(min(self.cfg.stream_poll_s, 0.05))
+        # drained: every accepted stream reached a terminal state (the
+        # _serve_loop finally block does the teardown)
+
+    # -- handler-thread entry points --------------------------------------
+
+    def _next_uid(self) -> int:
+        with self._lock:
+            self._uid += 1
+            return self._uid
+
+    def _command(self, cmd: dict, timeout: float = 120.0) -> dict:
+        """Enqueue a command for the serve loop and wait for its reply.
+        On deadline/stop the command is marked ABANDONED so the loop skips
+        (or undoes) it — a submit the client was told was refused must not
+        be silently admitted later."""
+        cmd["event"] = threading.Event()
+        self._cmds.put(cmd)
+        deadline = time.monotonic() + timeout
+        while not cmd["event"].wait(timeout=0.5):
+            if self._stopped or time.monotonic() > deadline:
+                cmd["abandoned"] = True
+                # one last grace: the loop may be completing it right now.
+                # The loop strips "stream" before setting the event when
+                # it undoes an abandoned submit, so stream-present after
+                # the event means the submit genuinely stands.
+                if not cmd["event"].wait(timeout=0.25) or "stream" not in cmd:
+                    cmd.setdefault("error", RequestRejected(
+                        cmd.get("uid", -1), "shutting_down",
+                        "gateway stopped before the command was processed"))
+                break
+        return cmd
+
+    def retry_after_s(self) -> int:
+        """The ``Retry-After`` hint on 429/503: configured, or derived
+        from the autoscaler's cooldown (the earliest instant the fleet
+        could have grown), with a 1-second floor."""
+        if self.cfg.retry_after_s > 0:
+            return max(1, int(round(self.cfg.retry_after_s)))
+        asc = getattr(self.router, "_autoscaler", None)
+        if asc is not None:
+            return max(1, int(round(asc.cfg.cooldown_s)))
+        return 1
+
+    def healthz(self) -> tuple[int, dict]:
+        states = self.router.replica_states()
+        healthy = sum(1 for s in states.values() if s == "healthy")
+        with self._lock:
+            draining = self._draining
+            open_streams = len(self._streams)
+        body = {
+            "status": ("draining" if draining
+                       else "ok" if healthy else "unhealthy"),
+            "healthy_replicas": healthy,
+            "replicas": {str(k): v for k, v in states.items()},
+            "open_streams": open_streams,
+            "brownout": bool(self.router.brownout),
+        }
+        return (200 if body["status"] == "ok" else 503), body
+
+    def telemetry_snapshot(self) -> dict:
+        """The Router's fleet snapshot plus a ``gateway`` section — the
+        gateway's stage events ride ``request_timeline`` merges."""
+        snap = self.router.telemetry_snapshot()
+        with self._lock:
+            open_streams = len(self._streams)
+        snap["gateway"] = {
+            "gateway_id": self.gateway_id,
+            "open_streams": open_streams,
+            "request_trace": self.tracer.events(),
+        }
+        return snap
+
+
+# -- the HTTP handler ---------------------------------------------------------
+
+
+def _make_handler(gw: HttpGateway):
+    """Handler class closed over the gateway (http.server instantiates one
+    per connection; state lives on ``gw``)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        # read deadline for request lines/bodies: a client that connects
+        # and goes silent must not pin a handler thread forever
+        timeout = 30.0
+
+        def log_message(self, fmt, *args):  # http.server stderr chatter
+            pass
+
+        # -- plumbing ----------------------------------------------------
+
+        def _reply_json(self, status: int, body: dict,
+                        headers: dict | None = None) -> None:
+            payload = json.dumps(body).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _sse_event(self, event: str, data: dict) -> None:
+            self.wfile.write(
+                f"event: {event}\ndata: {json.dumps(data)}\n\n".encode())
+            self.wfile.flush()
+
+        # -- routes ------------------------------------------------------
+
+        def do_GET(self):
+            try:
+                self._do_get()
+            except (ConnectionError, socket.timeout, OSError):
+                # the client vanished mid-reply: nothing to contain (GET
+                # routes hold no fleet state), nothing worth a traceback
+                gw.telemetry.counter("gateway/disconnects").inc()
+
+        def _do_get(self):
+            gw.telemetry.counter("gateway/http_requests").inc()
+            if self.path == "/healthz":
+                status, body = gw.healthz()
+                self._reply_json(status, body)
+                return
+            if self.path == "/metrics":
+                text = prometheus_text(gw.telemetry.registry)
+                payload = text.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
+            self._reply_json(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self):
+            try:
+                self._do_post()
+            except (ConnectionError, socket.timeout, OSError):
+                # a reply write to a vanished client — the SSE path has
+                # its own containment (cancel); this guard covers the
+                # JSON replies (rejections, blocking mode) whose request
+                # is already terminal or was never admitted
+                gw.telemetry.counter("gateway/disconnects").inc()
+
+        def _do_post(self):
+            gw.telemetry.counter("gateway/http_requests").inc()
+            if self.path != "/v1/generate":
+                self._reply_json(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                req, stream_mode = self._parse_generate()
+            except _HttpError as e:
+                gw.telemetry.counter("gateway/bad_requests").inc()
+                self._reply_json(e.status, {"error": e.message})
+                return
+            if gw._draining:
+                # SIGTERM discipline: stop ACCEPTING first; in-flight
+                # streams keep draining underneath
+                gw.telemetry.counter("gateway/rejected").inc()
+                self._reply_json(503, {"error": "gateway shutting down",
+                                       "reason": "shutting_down"},
+                                 {"Retry-After": gw.retry_after_s()})
+                return
+            t0 = time.monotonic()
+            cmd = gw._command({"op": "submit", "request": req})
+            gw.telemetry.histogram("gateway/submit_wait_sec").observe(
+                time.monotonic() - t0)
+            err = cmd.get("error")
+            if err is not None:
+                self._reply_rejected(req, err)
+                return
+            stream = cmd["stream"]
+            if stream_mode:
+                self._stream_sse(req, stream)
+            else:
+                self._reply_blocking(req, stream)
+
+        # -- request parsing ---------------------------------------------
+
+        def _parse_generate(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            if length <= 0:
+                raise _HttpError(400, "missing request body")
+            if length > gw.cfg.max_body_bytes:
+                raise _HttpError(
+                    413, f"body of {length} bytes exceeds "
+                         f"max_body_bytes={gw.cfg.max_body_bytes}")
+            try:
+                body = json.loads(self.rfile.read(length).decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as e:
+                raise _HttpError(400, f"malformed JSON body: {e}") from e
+            if not isinstance(body, dict):
+                raise _HttpError(400, "body must be a JSON object")
+            prompt = body.get("prompt")
+            if (not isinstance(prompt, list) or not prompt
+                    or not all(isinstance(t, int) for t in prompt)):
+                raise _HttpError(
+                    400, "prompt must be a non-empty list of token ids")
+            try:
+                priority = int(self.headers.get("X-DSTPU-Priority") or 0)
+                deadline_s = float(
+                    self.headers.get("X-DSTPU-Deadline-S") or 0.0)
+            except ValueError as e:
+                raise _HttpError(
+                    400, f"malformed X-DSTPU-Priority/X-DSTPU-Deadline-S "
+                         f"header: {e}") from e
+            from ..inference.serving import Request  # lazy: pulls jax
+
+            try:
+                req = Request(
+                    uid=gw._next_uid(),
+                    prompt=np.asarray(prompt, np.int32),
+                    max_new_tokens=int(body.get("max_new_tokens", 32)),
+                    temperature=float(body.get("temperature", 0.0)),
+                    top_k=int(body.get("top_k", 0)),
+                    top_p=float(body.get("top_p", 1.0)),
+                    eos_token=(None if body.get("eos_token") is None
+                               else int(body["eos_token"])),
+                    arrival_time=gw.router.now(),
+                    deadline_s=deadline_s,
+                    priority=priority,
+                )
+            except (TypeError, ValueError) as e:
+                raise _HttpError(400, f"bad request field: {e}") from e
+            return req, bool(body.get("stream", True))
+
+        def _reply_rejected(self, req, err) -> None:
+            gw.telemetry.counter("gateway/rejected").inc()
+            if isinstance(err, RequestRejected):
+                status = _REASON_STATUS.get(err.reason, 429)
+                headers = {"Retry-After": gw.retry_after_s()}
+                self._reply_json(status, {
+                    "error": str(err), "reason": err.reason,
+                    "uid": req.uid}, headers)
+                return
+            # ValueError: the request itself is unservable (budget
+            # violation, bad field) — the client's fault, not load
+            self._reply_json(400, {"error": str(err), "uid": req.uid})
+
+        # -- response modes ----------------------------------------------
+
+        def _reply_blocking(self, req, stream: _Stream) -> None:
+            """``"stream": false``: wait for the terminal result, reply
+            with one JSON document. No mid-flight disconnect detection
+            here — nothing is written until the request is terminal, so a
+            vanished reader surfaces only at the final write (contained
+            by do_POST's transport guard); SSE is the mode with bounded
+            disconnect→cancel containment."""
+            with stream.cond:
+                while not stream.done:
+                    stream.cond.wait(timeout=gw.cfg.stream_poll_s)
+                    if gw._stopped:
+                        break
+                res = stream.result
+            gw._close_stream(req.uid)
+            if res is None:
+                self._reply_json(503, {"error": "gateway stopped before "
+                                       "the request finished",
+                                       "uid": req.uid})
+                return
+            self._reply_json(200, _result_json(req.uid, res))
+            gw.tracer.record(req.uid, "stream_done",
+                             status=res.status, n_tokens=len(res.tokens))
+            gw.telemetry.counter("gateway/streams_done").inc()
+
+        def _stream_sse(self, req, stream: _Stream) -> None:
+            """SSE mode: one ``token`` event per generated token as the
+            feed advances, keepalive comments while idle, a final ``done``
+            event; ANY write failure (gone client, stalled reader past the
+            write deadline) cancels the request fleet-side."""
+            uid = req.uid
+            # the slow-reader deadline: a client that stops draining its
+            # socket turns the next send into a timeout, which is treated
+            # exactly like a disconnect. 0 genuinely DISABLES it — the
+            # class-level 30s request-read timeout must not linger on the
+            # stream or the documented "0 = undeadlined writes" is false
+            self.connection.settimeout(
+                gw.cfg.write_timeout_s if gw.cfg.write_timeout_s > 0
+                else None)
+            t_start = time.monotonic()
+            sent = 0
+            started = False
+            last_write = time.monotonic()
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.send_header("X-DSTPU-Uid", str(uid))
+                self.end_headers()
+                while True:
+                    with stream.cond:
+                        if len(stream.tokens) <= sent and not stream.done:
+                            stream.cond.wait(timeout=gw.cfg.stream_poll_s)
+                        toks = list(stream.tokens)
+                        done, res = stream.done, stream.result
+                    for tok in toks[sent:]:
+                        self._sse_event("token", {"i": sent, "token": tok})
+                        sent += 1
+                        last_write = time.monotonic()
+                        if not started:
+                            started = True
+                            gw.tracer.record(uid, "stream_started")
+                        self._maybe_inject(uid, sent)
+                    if done:
+                        self._sse_event(
+                            "done",
+                            _result_json(uid, res) if res is not None
+                            else {"uid": uid, "status": "unknown"})
+                        break
+                    if gw._stopped:
+                        break
+                    if time.monotonic() - last_write > 1.0:
+                        # keepalive comment: bounds how long a vanished
+                        # client can sit undetected holding a slot
+                        self.wfile.write(b": keepalive\n\n")
+                        self.wfile.flush()
+                        last_write = time.monotonic()
+                        gw.telemetry.counter("gateway/keepalives").inc()
+            except (BrokenPipeError, ConnectionResetError, socket.timeout,
+                    OSError) as e:
+                self._on_disconnect(uid, sent, e)
+                return
+            except _InjectedDisconnect as e:
+                self._on_disconnect(uid, sent, e)
+                try:
+                    self.connection.close()
+                except OSError:
+                    pass
+                return
+            gw._close_stream(uid)
+            gw.tracer.record(uid, "stream_done",
+                             status=res.status if res is not None
+                             else "unknown",
+                             n_tokens=sent,
+                             stream_sec=round(time.monotonic() - t_start, 4))
+            gw.telemetry.counter("gateway/streams_done").inc()
+            gw.telemetry.histogram("gateway/stream_sec").observe(
+                time.monotonic() - t_start)
+
+        def _maybe_inject(self, uid: int, sent: int) -> None:
+            if gw._inj is None:
+                return
+            if gw._inj.gateway_disconnect(uid, sent):
+                gw.telemetry.counter("gateway/injected_faults").inc()
+                raise _InjectedDisconnect(
+                    f"fault injection: gateway_disconnect on uid {uid} "
+                    f"after token {sent}")
+            if gw._inj.gateway_stall(uid, sent):
+                gw.telemetry.counter("gateway/injected_faults").inc()
+                gw.telemetry.counter("gateway/stalls").inc()
+                raise _InjectedDisconnect(
+                    f"fault injection: gateway_stall (write deadline "
+                    f"overrun) on uid {uid} after token {sent}")
+
+        def _on_disconnect(self, uid: int, sent: int, exc) -> None:
+            """The vanished/stalled reader path: cancel fleet-side so the
+            slot and prefix refs are freed, record the edge."""
+            if isinstance(exc, socket.timeout):
+                gw.telemetry.counter("gateway/stalls").inc()
+            gw.telemetry.counter("gateway/disconnects").inc()
+            gw.tracer.record(uid, "client_disconnected", tokens_sent=sent,
+                             error=type(exc).__name__)
+            log_dist(
+                f"gateway {gw.gateway_id}: client for uid {uid} gone after "
+                f"{sent} tokens ({type(exc).__name__}) — cancelling",
+                ranks=[0])
+            gw._command({"op": "cancel", "uid": uid})
+
+    return Handler
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class _InjectedDisconnect(Exception):
+    """Raised by the fault sites inside the stream write path — takes the
+    exact containment route a real transport error takes."""
+
+
+def _result_json(uid: int, res) -> dict:
+    return {
+        "uid": uid,
+        "status": res.status,
+        "tokens": [int(t) for t in np.asarray(res.tokens).reshape(-1)],
+        "n_tokens": int(np.asarray(res.tokens).size),
+        "prompt_len": int(res.prompt_len),
+        "ttft_s": round(float(res.ttft), 6),
+        "requeues": int(res.requeues),
+    }
+
+
+__all__ = ["HttpGateway"]

@@ -188,7 +188,7 @@ class WorkerHost:
         return None if res is None else encode_result(res)
 
     def step(self, now=None, enforce_deadlines: bool = True,
-             ack=None) -> dict:
+             ack=None, progress: bool = False) -> dict:
         for uid in ack or []:
             try:
                 self._unacked.remove(int(uid))
@@ -204,13 +204,23 @@ class WorkerHost:
             res = self.engine.result(u)
             if res is not None:
                 results[str(u)] = encode_result(res)
-        return {
+        reply = {
             "uids": list(self._unacked),
             "results": results,
             "trace": self.engine.take_trace_flush(256),
             "compiled": self.engine.last_step_compiled,
             **self._state(now),
         }
+        if progress:
+            # tokens-so-far per decoding slot: the gateway's SSE streams
+            # advance from this piggyback — zero extra round trips.
+            # OPT-IN (the gateway flips it via Router.
+            # enable_stream_progress): re-sending each stream's full
+            # token list per step is O(tokens^2) wire over a generation,
+            # and a fleet with no streaming front door must not pay it
+            reply["progress"] = {
+                str(u): t for u, t in self.engine.live_progress().items()}
+        return reply
 
     def live_requests(self) -> list:
         return [encode_request(r) for r in self.engine.live_requests()]
@@ -397,6 +407,20 @@ class WorkerSupervisor:
         self.respawns = 0
 
     # -- spawn -----------------------------------------------------------
+
+    def set_spec(self, spec: dict) -> None:
+        """Install a NEW engine spec for future (re)spawns — the rolling
+        upgrade's generation replacement (``Router.rolling_upgrade``):
+        running workers keep serving their old generation's spec; each
+        retire→spawn wave boots the new one. Durable write (tmp + fsync +
+        rename) so a crash mid-upgrade never leaves a torn spec for the
+        next respawn to boot from."""
+        tmp = self.spec_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(spec, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.spec_path)
 
     def _listen_address(self, slot: int) -> str:
         """The address the slot's NEXT generation binds: a per-generation
@@ -606,14 +630,16 @@ class WorkerSupervisor:
                     slot, proc.returncode)
 
     def shutdown(self, sig: int = signal.SIGTERM, timeout: float = 10.0) -> None:
-        for slot, proc in self._procs.items():
+        # snapshot: a background retire (rolling upgrade) may pop slots
+        # concurrently, and dict iteration must not race it
+        for slot, proc in list(self._procs.items()):
             if proc.poll() is None:
                 try:
                     os.kill(proc.pid, sig)
                 except OSError:
                     pass
         deadline = time.monotonic() + timeout
-        for proc in self._procs.values():
+        for proc in list(self._procs.values()):
             remaining = max(0.1, deadline - time.monotonic())
             try:
                 proc.wait(timeout=remaining)

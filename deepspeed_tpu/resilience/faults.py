@@ -54,6 +54,16 @@ Fault sites (see docs/resilience.md for where each is wired):
   ``rpc_garbled_frame``  the Nth reply frame of a method fails the
                       magic/CRC check (``RpcGarbledFrame``; the stream is
                       desynchronized, so the socket is closed too).
+  ``gateway_disconnect``  the HTTP gateway's SSE stream for a request sees
+                      its client vanish after the Nth streamed token (the
+                      write raises as if the peer reset) — the gateway
+                      must ``Router.cancel`` the request and free its slot
+                      (launcher/http_gateway.py consumes this).
+  ``gateway_stall``   the stream's client stops READING after the Nth
+                      token: the send blocks past the gateway's write
+                      deadline (simulated as a send timeout). Same
+                      containment contract as a disconnect — a slow reader
+                      must not hold a slot or a handler thread hostage.
 
 Two selection modes compose:
 
@@ -90,7 +100,8 @@ class FaultInjector:
 
     SITES = ("nan_grads", "io_error", "io_flaky", "garbage_logits", "preempt",
              "replica_dead", "replica_hang",
-             "rpc_timeout", "rpc_conn_reset", "rpc_garbled_frame")
+             "rpc_timeout", "rpc_conn_reset", "rpc_garbled_frame",
+             "gateway_disconnect", "gateway_stall")
 
     def __init__(self, cfg: Any = None):
         self.enabled = bool(_get(cfg, "enabled", False)) if cfg is not None else False
@@ -118,6 +129,13 @@ class FaultInjector:
                                   for p in _get(cfg, "rpc_conn_reset_at", []) or []}
         self.rpc_garbled_at = {(str(p[0]), int(p[1]))
                                for p in _get(cfg, "rpc_garbled_at", []) or []}
+        # gateway stream faults: [uid, nth-streamed-token] pairs (1-based)
+        self.gateway_disconnect_at = {
+            tuple(int(x) for x in p)
+            for p in _get(cfg, "gateway_disconnect_at", []) or []}
+        self.gateway_stall_at = {
+            tuple(int(x) for x in p)
+            for p in _get(cfg, "gateway_stall_at", []) or []}
         self._writes = 0  # guarded-write clock (io_error site)
         self._fired: set = set()  # list-mode keys fire exactly once
         self._lock = threading.Lock()
@@ -249,6 +267,24 @@ class FaultInjector:
         return self._fire("rpc_garbled_frame",
                           (method, call_n) in self.rpc_garbled_at,
                           (method, call_n))
+
+    def gateway_disconnect(self, uid: int, token_n: int) -> bool:
+        """True if the SSE stream for request ``uid`` should observe its
+        client gone after streaming token ``token_n`` (1-based)."""
+        if not self.enabled:
+            return False
+        return self._fire("gateway_disconnect",
+                          (uid, token_n) in self.gateway_disconnect_at,
+                          (uid, token_n))
+
+    def gateway_stall(self, uid: int, token_n: int) -> bool:
+        """True if the stream's reader should stall (send deadline
+        overrun) after token ``token_n`` (1-based)."""
+        if not self.enabled:
+            return False
+        return self._fire("gateway_stall",
+                          (uid, token_n) in self.gateway_stall_at,
+                          (uid, token_n))
 
     def stats(self) -> dict:
         return {

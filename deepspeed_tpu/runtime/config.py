@@ -346,6 +346,12 @@ class FaultInjectionConfig:
       clocks) at which the serving RPC transport loses a reply to its
       deadline, drops the connection after the call executes, or corrupts
       the reply frame (``inference/rpc.py`` consumes these client-side).
+    - ``gateway_disconnect_at`` / ``gateway_stall_at``: ``[uid, nth_token]``
+      pairs (1-based token counts) at which the HTTP gateway's SSE stream
+      for request ``uid`` observes its client vanish (disconnect) or stop
+      reading (slow-reader write stall) — both must free the request's
+      slot via ``Router.cancel`` (``launcher/http_gateway.py`` consumes
+      these server-side; docs/resilience.md).
     - ``rate`` in [0, 1] with optional ``sites`` allowlist
       (``nan_grads`` | ``io_error`` | ``io_flaky`` | ``garbage_logits`` |
       ``preempt`` | ``replica_dead`` | ``replica_hang``).
@@ -367,6 +373,8 @@ class FaultInjectionConfig:
     rpc_timeout_at: list = field(default_factory=list)
     rpc_conn_reset_at: list = field(default_factory=list)
     rpc_garbled_at: list = field(default_factory=list)
+    gateway_disconnect_at: list = field(default_factory=list)
+    gateway_stall_at: list = field(default_factory=list)
 
     def __post_init__(self):
         if not 0.0 <= self.rate <= 1.0:
@@ -380,7 +388,8 @@ class FaultInjectionConfig:
                                  "garbage_logits", "preempt",
                                  "replica_dead", "replica_hang",
                                  "rpc_timeout", "rpc_conn_reset",
-                                 "rpc_garbled_frame"}
+                                 "rpc_garbled_frame",
+                                 "gateway_disconnect", "gateway_stall"}
         if bad:
             raise DeepSpeedConfigError(
                 f"fault_injection.sites contains unknown site(s) {sorted(bad)}")
@@ -399,6 +408,13 @@ class FaultInjectionConfig:
                     raise DeepSpeedConfigError(
                         f"fault_injection.{name} entries must be "
                         f"[method, nth_call] (str, int) pairs, got {p!r}")
+        for name in ("gateway_disconnect_at", "gateway_stall_at"):
+            for p in getattr(self, name):
+                if (not isinstance(p, (list, tuple)) or len(p) != 2
+                        or not all(isinstance(x, int) for x in p)):
+                    raise DeepSpeedConfigError(
+                        f"fault_injection.{name} entries must be "
+                        f"[uid, nth_token] int pairs, got {p!r}")
 
 
 @dataclass
@@ -808,6 +824,65 @@ class AutoscaleConfig:
 
 
 @dataclass
+class GatewayConfig:
+    """``serving.gateway`` block (consumed by
+    ``launcher/http_gateway.HttpGateway``; docs/serving.md "HTTP front door
+    & rolling upgrades").
+
+    - ``enabled``: serve the Router over the HTTP/SSE front door (ignored
+      by code that constructs ``HttpGateway`` directly — drills and tests
+      pass the block explicitly).
+    - ``host``: listen address (``127.0.0.1`` for same-host clients; a
+      routable address to face real traffic).
+    - ``port``: listen port; 0 (the default) binds an OS-assigned ephemeral
+      port, resolved at start and exposed as ``HttpGateway.port``.
+    - ``stream_poll_s``: how long an idle SSE stream waits for new tokens
+      before re-checking its feed (also the serve loop's idle pace). Lower
+      = lower token latency, higher host spin.
+    - ``write_timeout_s``: per-send socket deadline on streaming responses.
+      A reader that stops draining its socket (slow-reader stall) blocks the
+      server's send past this budget and is treated as a DISCONNECT — the
+      request is cancelled, its slot freed. 0 disables (an undeadlined
+      write can hang a handler thread forever — keep it > 0 in production).
+    - ``retry_after_s``: the ``Retry-After`` hint on 429/503 responses;
+      0 derives it from the autoscaler's ``cooldown_s`` (the earliest
+      instant more capacity could exist) with a 1s floor.
+    - ``max_body_bytes``: request-body bound; larger POSTs are rejected 413
+      before parsing (a gateway must not buffer unbounded client bytes).
+    - ``shutdown_grace_s``: how long a SIGTERM drain waits for in-flight
+      streams to finish before closing their connections anyway (0 =
+      unbounded — trust the deadline machinery underneath).
+    """
+
+    enabled: bool = False
+    host: str = "127.0.0.1"
+    port: int = 0
+    stream_poll_s: float = 0.05
+    write_timeout_s: float = 10.0
+    retry_after_s: float = 0.0
+    max_body_bytes: int = 1 << 20
+    shutdown_grace_s: float = 30.0
+
+    def __post_init__(self):
+        if not 0 <= self.port <= 65535:
+            raise DeepSpeedConfigError(
+                f"serving.gateway.port must be in [0, 65535], got {self.port}")
+        if self.stream_poll_s <= 0:
+            raise DeepSpeedConfigError(
+                f"serving.gateway.stream_poll_s must be > 0, "
+                f"got {self.stream_poll_s}")
+        if self.write_timeout_s < 0 or self.retry_after_s < 0 \
+                or self.shutdown_grace_s < 0:
+            raise DeepSpeedConfigError(
+                "serving.gateway write_timeout_s/retry_after_s/"
+                "shutdown_grace_s must be >= 0")
+        if self.max_body_bytes < 1:
+            raise DeepSpeedConfigError(
+                f"serving.gateway.max_body_bytes must be >= 1, "
+                f"got {self.max_body_bytes}")
+
+
+@dataclass
 class RouterConfig:
     """``serving.router`` block (consumed by ``inference/router.Router``;
     docs/serving.md "Multi-replica router").
@@ -890,6 +965,7 @@ class ServingConfig:
     chunked_prefill: ChunkedPrefillConfig = field(default_factory=ChunkedPrefillConfig)
     fault_injection: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
     router: RouterConfig = field(default_factory=RouterConfig)
+    gateway: GatewayConfig = field(default_factory=GatewayConfig)
     # observability sub-blocks (same schema as telemetry.ledger /
     # telemetry.request_trace — the serving engine owns its own Telemetry)
     ledger: LedgerConfig = field(default_factory=LedgerConfig)
@@ -904,6 +980,8 @@ class ServingConfig:
             self.fault_injection = _build(FaultInjectionConfig, self.fault_injection)
         if isinstance(self.router, dict):
             self.router = _build(RouterConfig, self.router)
+        if isinstance(self.gateway, dict):
+            self.gateway = _build(GatewayConfig, self.gateway)
         if isinstance(self.ledger, dict):
             self.ledger = _build(LedgerConfig, self.ledger)
         if isinstance(self.request_trace, dict):

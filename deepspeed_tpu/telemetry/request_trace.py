@@ -32,11 +32,18 @@ from collections import deque
 from typing import Iterable
 
 # canonical stage order, used to sort same-timestamp events into a sane
-# timeline and to pick the phase boundaries for the Perfetto slices
+# timeline and to pick the phase boundaries for the Perfetto slices.
+# Gateway stages (launcher/http_gateway.py, stamped replica_id
+# "gateway<id>") interleave at fractional ranks: http_accepted precedes
+# the router's dispatch, stream_started follows the first token onto the
+# wire, client_disconnected precedes the cancel's terminal event, and
+# stream_done is the last thing a request's timeline can record.
 _STAGE_ORDER = {
+    "http_accepted": -1,
     "arrived": 0, "dispatched": 1, "requeued": 2, "admitted": 3,
-    "prefix_hit": 4, "chunk": 5, "first_token": 6, "quarantine": 7,
-    "failover": 8, "terminal": 9,
+    "prefix_hit": 4, "chunk": 5, "first_token": 6, "stream_started": 6.5,
+    "quarantine": 7, "failover": 8, "shed": 8.25,
+    "client_disconnected": 8.5, "terminal": 9, "stream_done": 10,
 }
 
 
@@ -112,6 +119,12 @@ def request_timeline(snapshot: dict, uid: int | None = None) -> list[dict]:
     rt = snapshot.get("router")
     if isinstance(rt, dict):
         evs.extend(rt.get("request_trace") or [])
+    gw = snapshot.get("gateway")
+    if isinstance(gw, dict):
+        # HTTP front-door stages (http_accepted/stream_*/client_
+        # disconnected) merge onto the same per-uid timeline, stamped
+        # with the gateway's id (launcher/http_gateway.py)
+        evs.extend(gw.get("request_trace") or [])
     for rid, rep in (snapshot.get("replicas") or {}).items():
         for ev in rep.get("request_trace") or []:
             ev = dict(ev)

@@ -162,6 +162,37 @@ def test_surge_argv_contract_exits_2_with_usage(argv):
     assert "Traceback" not in proc.stderr
 
 
+@pytest.mark.parametrize("argv", [
+    ("--gateway-chaos", "7"),                       # unexpected operand
+    ("--gateway-chaos", "--gateway-seed", "xyz"),   # non-numeric seed
+    ("--gateway-chaos", "--gateway-seed"),          # dangling seed flag
+])
+def test_gateway_chaos_argv_contract_exits_2_with_usage(argv):
+    """``--gateway-chaos`` follows the ``--chaos``/``--chaos-serving``/
+    ``--surge`` contract: malformed operands exit 2 with a usage line on
+    stderr — never a traceback, never a started drill."""
+    proc = _run_bench_argv(*argv)
+    assert proc.returncode == 2, (argv, proc.stderr)
+    assert "usage: bench.py --gateway-chaos" in proc.stderr
+    assert "Traceback" not in proc.stderr
+
+
+def test_drill_rows_carry_the_stamp_contract(bench):
+    """Every CPU-pinned drill row (incl. the --gateway-chaos row) carries
+    the full ``_stamp_row`` provenance block — platform cpu, comparable
+    False, and the labeled-null perf-xray keys (``step_anatomy: null``) —
+    via the shared ``_drill_stamp`` helper, so trajectory tooling can
+    never mistake a correctness soak for a perf datapoint."""
+    stamp = bench._drill_stamp()
+    assert stamp == {"platform": "cpu", "comparable": False, "mfu": None,
+                     "roofline": "unrated:cpu", "step_anatomy": None}
+    # the stamp agrees with what _stamp_row would enforce on a cpu row
+    stamped = bench._stamp_row(dict(stamp), "drill")
+    assert stamped["comparable"] is False
+    assert stamped["roofline"] == "unrated:cpu"
+    assert stamped["step_anatomy"] is None
+
+
 def test_tpu_row_stays_comparable(bench, monkeypatch, capsys):
     monkeypatch.delenv("DSTPU_BENCH_FORCE_PREFLIGHT_FAIL", raising=False)
     monkeypatch.setenv("DSTPU_BENCH_PREFLIGHT_ATTEMPTS", "2")
