@@ -1258,6 +1258,449 @@ def _gateway_chaos(seed: int) -> int:
         sup.shutdown()
 
 
+def _router_chaos_child(cfg_path: str) -> int:
+    """The CONTROL-PLANE process of the ``--router-chaos`` drill: worker
+    supervisor (ADOPTING any still-running workers a dead predecessor left
+    behind via their fsync'd pidfiles), a journaled Router (cold-start
+    recovery happens in its constructor when the journal holds state), and
+    the HTTP/SSE gateway. Prints a ``gw_ready`` JSON line (port + recovery
+    counters), serves until SIGTERM, then drains and prints a ``final``
+    stats line. The parent SIGKILLs the FIRST incarnation mid-traffic and
+    starts a second one against the same workdir + journal."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests", ".xla_cache"))
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+
+    from deepspeed_tpu.inference import Router
+    from deepspeed_tpu.launcher.http_gateway import HttpGateway
+    from deepspeed_tpu.launcher.serving_worker import WorkerSupervisor
+    from deepspeed_tpu.resilience.preemption import PreemptionGuard
+
+    guard = PreemptionGuard(["SIGTERM"])
+    guard.install()
+    sup = WorkerSupervisor(
+        cfg["spec"], cfg["workers"], workdir=cfg["workdir"],
+        transport={"family": "tcp", "host": "127.0.0.1", "port_base": 0,
+                   "call_timeout_s": 120.0, "boot_timeout_s": 300.0,
+                   "heartbeat_timeout_s": 30.0, "base_delay_s": 0.05,
+                   "max_delay_s": 0.2, "jitter": 0.0},
+        seed=int(cfg["seed"]))
+    adopted = sup.adopt()
+    for slot in range(int(cfg["workers"])):
+        if slot not in adopted:
+            sup.spawn(slot)
+    clients = [sup.client(s) for s in range(int(cfg["workers"]))]
+    router = Router(
+        config={"router": {
+            "replicas": int(cfg["workers"]), "max_queue_len": 32,
+            "health": {"timeout": 60.0},
+            "journal": {"enabled": True, "path": cfg["journal"]}}},
+        replica_engines=clients)
+
+    def counters():
+        snap = router.telemetry.registry.snapshot()["counters"]
+        return {k: int(v) for k, v in snap.items()
+                if k.startswith(("router/recovery/", "router/journal/",
+                                 "gateway/"))}
+
+    gw = HttpGateway(router, {"stream_poll_s": 0.01, "write_timeout_s": 30.0},
+                     gateway_id=1)
+    gw.start()
+    print(json.dumps({"event": "gw_ready", "port": gw.port,
+                      "pid": os.getpid(), "adopted": sorted(adopted),
+                      "recovery": counters()}), flush=True)
+    while not guard.pending():
+        time.sleep(0.05)
+    gw.stop()
+    # the serve loop is stopped: direct per-replica queries are safe now
+    final = {"event": "final", "replica_states": router.replica_states(),
+             "loads": {}, "decode_compiles": {}, "prefix_leaks": {},
+             "counters": counters()}
+    for rid, state in router.replica_states().items():
+        if state != "healthy":
+            continue
+        eng = router._replicas[rid].engine
+        final["loads"][str(rid)] = int(eng.load)
+        final["decode_compiles"][str(rid)] = int(
+            eng.compile_counts().get("decode", 0))
+        pstats = eng.prefix_cache_stats()
+        final["prefix_leaks"][str(rid)] = [
+            e for e in (pstats or {}).get("entries", []) if e.get("refs")]
+    print(json.dumps(final), flush=True)
+    if cfg.get("shutdown_workers"):
+        sup.shutdown()
+    return 0
+
+
+def _router_chaos(seed: int) -> int:
+    """Control-plane chaos drill (``bench.py --router-chaos``): 3 REAL TCP
+    worker processes under live HTTP/SSE traffic; the gateway+router
+    process is SIGKILL'd mid-prefill and mid-stream, then RESTARTED
+    against the same request journal and worker workdir. The restarted
+    brain adopts the surviving workers from their pidfiles, replays the
+    journal, reconciles the owner map over the new reconcile RPC round,
+    and clients ride the restart on idempotency keys + ``Last-Event-ID``
+    SSE resume. ASSERTS the crash-safe control-plane contract: zero
+    accepted-request loss, a retried idempotency key never forks a uid,
+    >= 1 SSE stream resumed across the restart with one bitwise-identical
+    token stream, bitwise greedy parity vs an unfaulted single-engine run
+    on EVERY completion, journal replay idempotence, slot/prefix-ref
+    occupancy back to 0, and watchdog RAISE held on every worker.
+    CPU-pinned correctness soak, never a trajectory datapoint."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests", ".xla_cache"))
+    import signal
+    import socket as socket_mod
+    import tempfile
+    import threading
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.inference.serving import Request, ServingEngine
+    from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+    t0 = time.perf_counter()
+    serving_cfg = {
+        "n_slots": 2, "max_seq_len": 128, "watchdog_mode": "raise",
+        "chunked_prefill": {"enabled": True, "chunk_size": 16},
+        "prefix_cache": {"enabled": True, "n_slots": 4, "block": 4,
+                         "insert_policy": "always", "min_hits": 1},
+    }
+    model_spec = {"vocab_size": 97, "max_seq_len": 128, "num_layers": 2,
+                  "num_heads": 4, "hidden_size": 32, "dtype": "float32",
+                  "loss_chunk_size": 0, "decode_attn": "xla",
+                  "pos_emb": "rotary"}
+    spec = {"model": model_spec, "engine_dtype": "fp32",
+            "serving": serving_cfg}
+
+    # -- the trace: burst A rides the kill, burst B rides the restart.
+    # Client 0 is the mid-PREFILL bait (90-token prompt through 16-token
+    # chunks); several burst-A streams are mid-DECODE at the kill.
+    rng = np.random.default_rng(seed)
+    n_req = 12
+    prompts, offsets, blocking = {}, {}, set()
+    prompts[0] = rng.integers(0, 97, size=90).astype(np.int32)
+    offsets[0] = 0.0
+    for i in range(1, n_req):
+        prompts[i] = rng.integers(
+            0, 97, size=int(rng.integers(5, 24))).astype(np.int32)
+        offsets[i] = (float(rng.uniform(0.0, 0.4)) if i < 6
+                      else float(rng.uniform(2.0, 6.0)))
+        if i % 4 == 3:
+            blocking.add(i)  # non-streaming clients ride the key alone
+
+    # -- unfaulted single-engine reference (identical PRNGKey(0) params) --
+    cfg = TransformerConfig(**{**model_spec, "dtype": jnp.float32})
+    ref_srv = ServingEngine(
+        InferenceEngine(model=Model(cfg), config={"dtype": "fp32"}),
+        config=serving_cfg)
+    for i in sorted(prompts):
+        ref_srv.submit(Request(uid=i, prompt=prompts[i], max_new_tokens=24))
+    ref = {i: [int(t) for t in r.tokens]
+           for i, r in ref_srv.drain().items()}
+
+    workdir = tempfile.mkdtemp(prefix="dstpu_rc_")
+    journal = os.path.join(workdir, "router.journal")
+    cfg_path = os.path.join(workdir, "drill.json")
+    child_cfg = {"spec": spec, "workers": 3, "workdir": workdir,
+                 "journal": journal, "seed": seed}
+
+    def launch(shutdown_workers=False, tag="c1"):
+        cc = dict(child_cfg, shutdown_workers=shutdown_workers)
+        path = os.path.join(workdir, f"drill_{tag}.json")
+        with open(path, "w") as f:
+            json.dump(cc, f)
+        log = open(os.path.join(workdir, f"{tag}.log"), "w")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--router-chaos-child", path],
+            stdout=log, stderr=subprocess.STDOUT)
+        return proc, log.name
+
+    def wait_ready(log_path, proc, timeout=600.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                with open(log_path) as f:
+                    raise AssertionError(
+                        f"control-plane child exited rc={proc.returncode} "
+                        f"during boot: {f.read()[-2000:]}")
+            try:
+                with open(log_path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line.startswith("{"):
+                            try:
+                                ev = json.loads(line)
+                            except ValueError:
+                                continue
+                            if ev.get("event") == "gw_ready":
+                                return ev
+            except OSError:
+                pass
+            time.sleep(0.1)
+        raise AssertionError("control-plane child never printed gw_ready")
+
+    def read_final(log_path):
+        with open(log_path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if ev.get("event") == "final":
+                        return ev
+        return None
+
+    state = {"port": None, "restart": threading.Event()}
+    outcomes = {i: {"attempts": 0, "uids": set(), "tokens": {},
+                    "resume_ids": [], "resumed": False, "done": None}
+                for i in prompts}
+
+    def http_attempt(i, out, resume_after):
+        """One POST; returns ('done', result) | ('dead', last_id) |
+        ('refused', None) when the gateway is not up."""
+        body = {"prompt": [int(t) for t in prompts[i]],
+                "max_new_tokens": 24}
+        if i in blocking:
+            body["stream"] = False
+        payload = json.dumps(body).encode()
+        headers = (f"POST /v1/generate HTTP/1.1\r\nHost: d\r\n"
+                   f"Content-Length: {len(payload)}\r\n"
+                   f"X-DSTPU-Idempotency-Key: rc{seed}-{i}\r\n")
+        if resume_after is not None:
+            headers += f"Last-Event-ID: {resume_after}\r\n"
+        try:
+            s = socket_mod.create_connection(("127.0.0.1", state["port"]),
+                                             timeout=240.0)
+        except OSError:
+            return "refused", None
+        try:
+            s.sendall(headers.encode() + b"\r\n" + payload)
+            data, headers_done, first_id = b"", False, None
+            while True:
+                try:
+                    chunk = s.recv(65536)
+                except OSError:
+                    chunk = b""
+                if not chunk:
+                    # connection died (the kill): report how far we got
+                    last = max(out["tokens"], default=None)
+                    return "dead", last
+                data += chunk
+                if not headers_done and b"\r\n\r\n" in data:
+                    headers_done = True
+                    head, data = data.split(b"\r\n\r\n", 1)
+                    status = int(head.split(b" ", 2)[1].decode())
+                    for line in head.split(b"\r\n"):
+                        if line.lower().startswith(b"x-dstpu-uid:"):
+                            out["uids"].add(int(line.split(b":")[1]))
+                    if i in blocking:
+                        # JSON document follows; read to socket close or
+                        # content-length — simplest: read until close
+                        cl = next((int(line.split(b":")[1])
+                                   for line in head.split(b"\r\n")
+                                   if line.lower().startswith(
+                                       b"content-length:")), None)
+                        while cl is not None and len(data) < cl:
+                            chunk = s.recv(65536)
+                            if not chunk:
+                                break
+                            data += chunk
+                        if status != 200:
+                            return "dead", None
+                        doc = json.loads(data.decode())
+                        out["uids"].add(int(doc["uid"]))
+                        return "done", doc
+                # parse complete SSE events as they arrive
+                while b"\n\n" in data:
+                    block, data = data.split(b"\n\n", 1)
+                    ev_id, ev_name, ev_data = None, None, None
+                    for line in block.splitlines():
+                        if line.startswith(b"id: "):
+                            ev_id = int(line[4:])
+                        elif line.startswith(b"event: "):
+                            ev_name = line[7:].decode()
+                        elif line.startswith(b"data: "):
+                            ev_data = json.loads(line[6:])
+                    if ev_name == "token":
+                        if first_id is None:
+                            first_id = ev_id
+                            out["resume_ids"].append(first_id)
+                        tok = int(ev_data["token"])
+                        prev = out["tokens"].get(ev_id)
+                        assert prev is None or prev == tok, (
+                            "re-delivered token diverged", i, ev_id)
+                        out["tokens"][ev_id] = tok
+                    elif ev_name == "done":
+                        return "done", ev_data
+        finally:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def client(i):
+        time.sleep(offsets[i])
+        out = outcomes[i]
+        resume_after = None
+        deadline = time.monotonic() + 600.0
+        while time.monotonic() < deadline:
+            out["attempts"] += 1
+            kind, got = http_attempt(i, out, resume_after)
+            if kind == "done":
+                out["done"] = got
+                return
+            if kind == "refused":
+                out["attempts"] -= 1  # never reached the gateway
+                time.sleep(0.25)
+                continue
+            # the connection died mid-flight: wait out the restart, then
+            # retry the SAME idempotency key — resuming the stream past
+            # the last received token id when we got any
+            state["restart"].wait(timeout=300.0)
+            if got is not None:
+                resume_after = got
+                out["resumed"] = True
+                out["resumed_from"] = got
+        raise AssertionError(f"client {i} never finished")
+
+    child = None
+    try:
+        child, log1 = launch(tag="c1")
+        ready = wait_ready(log1, child)
+        state["port"] = ready["port"]
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in sorted(prompts)]
+        for t in threads:
+            t.start()
+
+        # -- the kill: long prompt accepted (mid-prefill bait) AND some
+        # stream mid-decode (>= 2 tokens on the wire)
+        kill_deadline = time.monotonic() + 300.0
+        while True:
+            assert time.monotonic() < kill_deadline, (
+                "kill precondition never met",
+                {i: dict(o, tokens=len(o["tokens"]))
+                 for i, o in outcomes.items()})
+            streaming = any(len(o["tokens"]) >= 2 for i, o in
+                            outcomes.items() if i not in blocking and i != 0)
+            if outcomes[0]["uids"] and streaming:
+                break
+            time.sleep(0.01)
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait()
+        kill_t = time.perf_counter()
+
+        # -- restart the brain against the same journal + workdir --------
+        child, log2 = launch(shutdown_workers=True, tag="c2")
+        ready2 = wait_ready(log2, child)
+        state["port"] = ready2["port"]
+        state["restart"].set()
+        for t in threads:
+            t.join(timeout=600.0)
+        assert not any(t.is_alive() for t in threads), "client threads hung"
+
+        # -- drain the second brain and collect its final stats ----------
+        os.kill(child.pid, signal.SIGTERM)
+        child.wait(timeout=300.0)
+        final = read_final(log2)
+        assert final is not None, "restarted child printed no final stats"
+
+        # -- the crash-safe control-plane contract, asserted -------------
+        rec = ready2["recovery"]
+        assert rec.get("router/recovery/recoveries") == 1, rec
+        assert rec.get("router/recovery/adopted_requests", 0) >= 1, rec
+        # zero accepted-request loss + bitwise parity on EVERY completion
+        for i, out in outcomes.items():
+            assert out["done"] is not None, (i, out)
+            assert out["done"]["status"] == "ok", (i, out["done"])
+            assert len(out["uids"]) == 1, (
+                "a retried idempotency key forked a uid", i, out["uids"])
+            if i in blocking:
+                assert out["done"]["tokens"] == ref[i], (
+                    "blocking-mode tokens diverged", i)
+            else:
+                n = len(ref[i])
+                toks = [out["tokens"].get(k) for k in range(n)]
+                assert toks == ref[i], (
+                    "streamed tokens diverged/gapped", i, toks, ref[i])
+                assert out["done"]["tokens"] == ref[i], i
+        resumed = [i for i, o in outcomes.items() if o["resumed"]]
+        assert resumed, "no SSE stream resumed across the restart"
+        for i in resumed:
+            # continuity: the resumed attempt's FIRST token id is exactly
+            # one past the last id the dead gateway delivered — nothing
+            # re-sent, nothing skipped (Last-Event-ID honored)
+            ids = outcomes[i]["resume_ids"]
+            if len(ids) >= 2:
+                assert ids[1] == outcomes[i]["resumed_from"] + 1, (
+                    "resume did not continue at Last-Event-ID + 1",
+                    i, ids, outcomes[i]["resumed_from"])
+        # occupancy back to 0, watchdog RAISE held, prefix refs clean
+        assert final["loads"] and all(
+            v == 0 for v in final["loads"].values()), final["loads"]
+        assert all(v <= 1 for v in final["decode_compiles"].values()), final
+        assert all(not v for v in final["prefix_leaks"].values()), final
+        assert final["counters"].get("gateway/resumed_streams", 0) >= 1, (
+            final["counters"])
+        # journal replay is idempotent: two replays, equal states
+        from deepspeed_tpu.inference.journal import replay as _replay
+        assert _replay(journal) == _replay(journal)
+
+        from collections import Counter as _Counter
+
+        statuses = _Counter(o["done"]["status"] for o in outcomes.values())
+        print(json.dumps({
+            "metric": "router chaos drill (control-plane restart survived)",
+            "value": int(rec.get("router/recovery/adopted_requests", 0)
+                         + rec.get("router/recovery/recovered_results", 0)
+                         + rec.get("router/recovery/redispatched", 0)
+                         + len(resumed)),
+            "unit": "requests",
+            # CPU-pinned correctness soak: never a trajectory datapoint
+            **_drill_stamp(),
+            "workers": 3,
+            "transport": "tcp",
+            "n_requests": n_req,
+            "statuses": dict(statuses),
+            "adopted_workers": ready2["adopted"],
+            "recovery": {k.split("/", 2)[2]: v for k, v in rec.items()
+                         if k.startswith("router/recovery/")},
+            "resumed_streams": len(resumed),
+            "greedy_bitwise_match": True,
+            "restart_to_ready_s": round(time.perf_counter() - kill_t, 2),
+            "seed": seed,
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+        }), flush=True)
+        return 0
+    finally:
+        if child is not None and child.poll() is None:
+            try:
+                os.kill(child.pid, signal.SIGKILL)
+            except OSError:
+                pass
+        # reap any workers the drill leaked (pidfiles are the roster)
+        try:
+            for name in os.listdir(workdir):
+                if name.startswith("w") and name.endswith(".pid"):
+                    with open(os.path.join(workdir, name)) as f:
+                        info = json.load(f)
+                    try:
+                        os.kill(int(info["pid"]), signal.SIGKILL)
+                    except (OSError, ValueError):
+                        pass
+        except OSError:
+            pass
+
+
 def _drill_stamp():
     """The constant provenance block every CPU-pinned correctness drill
     stamps into its row: the ``_stamp_row`` platform/comparable/perf-xray
@@ -1461,6 +1904,28 @@ def _parent():
 
 
 if __name__ == "__main__":
+    if "--router-chaos-child" in sys.argv:
+        # internal: the control-plane process the --router-chaos parent
+        # launches (and SIGKILLs); not a user-facing drill entry
+        sys.exit(_router_chaos_child(
+            sys.argv[sys.argv.index("--router-chaos-child") + 1]))
+    if "--router-chaos" in sys.argv:
+        # usage-error exit 2 on malformed values (same contract as
+        # --chaos/--chaos-serving/--surge/--gateway-chaos)
+        try:
+            idx = sys.argv.index("--router-chaos")
+            if idx + 1 < len(sys.argv) and not sys.argv[idx + 1].startswith("--"):
+                raise ValueError(
+                    f"unexpected operand {sys.argv[idx + 1]!r} (the drill "
+                    "takes only --router-seed)")
+            rc_seed = 0
+            if "--router-seed" in sys.argv:
+                rc_seed = int(sys.argv[sys.argv.index("--router-seed") + 1])
+        except (IndexError, ValueError) as e:
+            print(f"usage: bench.py --router-chaos [--router-seed <int>] "
+                  f"({e})", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_router_chaos(rc_seed))
     if "--fault-rate" in sys.argv:
         try:
             rate = float(sys.argv[sys.argv.index("--fault-rate") + 1])

@@ -375,6 +375,81 @@ def check_unlogged_collective(pf: PyFile) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# append-durability — PR 14: the request journal's replay proof rests on
+# every appended record being ON DISK when submit() returns; an append-mode
+# open in a journal/WAL-shaped path without flush+fsync in scope is a
+# recovery guarantee that silently evaporates at the first power cut
+
+
+_APPEND_HINTS = ("journal", "wal")
+
+
+def _is_append_mode(node: ast.Call) -> Optional[str]:
+    """The mode string of an ``open(..., 'a...')`` call, else None."""
+    if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+        return None
+    mode = None
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+        mode = node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if isinstance(mode, str) and mode.startswith("a"):
+        return mode
+    return None
+
+
+@rule("append-durability",
+      "append-mode open() in a journal/WAL-shaped path (module or file "
+      "expression mentioning journal/wal) with no flush+fsync in scope — "
+      "an append whose durability a replay depends on must reach disk "
+      "before the caller is told it did (the request-journal discipline, "
+      "mirroring rename-durability)")
+def check_append_durability(pf: PyFile) -> list[Finding]:
+    rel = pf.rel.replace("\\", "/").lower()
+    module_shaped = any(h in rel for h in _APPEND_HINTS)
+    funcs = None
+    out = []
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        mode = _is_append_mode(node)
+        if mode is None:
+            continue
+        # "journal/WAL-shaped": the module is named for one, or the path
+        # expression mentions one — ordinary append logs (CSV monitors,
+        # JSONL sinks, autotuner trial logs) are advisory and exempt
+        path_src = ast.unparse(node.args[0]).lower() if node.args else ""
+        if not (module_shaped or any(h in path_src for h in _APPEND_HINTS)):
+            continue
+        if funcs is None:
+            funcs = _enclosing_functions(pf.tree)
+        enclosing = _innermost_function(funcs, node.lineno)
+        scope: ast.AST = enclosing if enclosing is not None else pf.tree
+        has_flush = any(isinstance(n, ast.Call)
+                        and _terminal_name(n.func) == "flush"
+                        for n in ast.walk(scope))
+        has_fsync = any(
+            isinstance(n, ast.Call)
+            and (name := _terminal_name(n.func)) is not None
+            and any(mark in name.lower() for mark in _DURABLE_MARKERS)
+            for n in ast.walk(scope))
+        if has_flush and has_fsync:
+            continue
+        where = (f"function {enclosing.name}()" if enclosing is not None
+                 else "module scope")
+        missing = [w for w, ok in (("flush", has_flush), ("fsync", has_fsync))
+                   if not ok]
+        out.append(Finding(
+            "append-durability", pf.rel, node.lineno,
+            f"append-mode open(mode={mode!r}) in {where} of a journal/WAL-"
+            f"shaped path with no {'/'.join(missing)} in scope — a replay "
+            f"that trusts this append needs it durable before the caller "
+            f"returns; flush+fsync it, or pragma an advisory-only append"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # rename-durability — PR 4 round 3: a rename that commits state must be
 # fsync-disciplined or a crash can surface a half-visible checkpoint
 

@@ -69,6 +69,7 @@ Deployment models — the SAME Router state machine drives both:
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -77,14 +78,21 @@ from typing import Optional
 
 import numpy as np
 
-from ..resilience import FaultInjector, RequestRejected, RpcError, RpcTimeout
+from ..resilience import (ControlPlaneCrash, FaultInjector, RequestRejected,
+                          RpcError, RpcTimeout)
 from ..resilience.retry import backoff_delay
 from ..runtime.config import (FaultInjectionConfig, RequestTraceConfig,
                               RouterConfig, RouterHealthConfig)
 from ..telemetry import RequestTracer, Telemetry
+from ..telemetry.request_trace import RESERVED_UID_BASE
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
 from .serving import Request, RequestResult, ServingEngine
+
+# per-process canary uid source: every rolling-upgrade wave's synthetic
+# generate gets a uid in the RESERVED band, unique across successive
+# upgrades on the same fleet (engines remember finished uids forever)
+_canary_uids = itertools.count()
 
 
 @dataclass
@@ -176,6 +184,31 @@ class Router:
             watchdog_mode=config.get("watchdog_mode", "warn"),
         )
         self._epoch = time.perf_counter()
+        # durable request journal (docs/serving.md "Crash-safe control
+        # plane"): accepted requests, terminals and cancels are journaled
+        # at the accept boundary; a restart with the same journal replays
+        # it, reconciles against surviving workers and re-dispatches the
+        # unaccounted remainder. Disabled = no journal object = ZERO new
+        # fsyncs on the submit/terminal hot path.
+        jc = rc.journal
+        self._journal = None
+        self._idem: dict[str, int] = {}  # idempotency key -> uid
+        if jc.enabled:
+            from .journal import RequestJournal
+
+            self._journal = RequestJournal(
+                jc.path, fsync=jc.fsync,
+                rotate_max_records=jc.rotate_max_records,
+                keep_terminals=jc.keep_terminals, telemetry=self.telemetry)
+            st = self._journal.state
+            if self._journal.recovered and st.epoch_wall is not None:
+                # continue the fleet clock across the restart: in-flight
+                # arrival times and deadlines were anchored to the dead
+                # process's epoch, and a fresh epoch would push queued
+                # arrivals into the apparent future
+                # dstpu: allow[wall-clock-verdict] -- cross-process epoch continuation: perf_counter anchors die with their process, wall time is the only shared clock, and no liveness verdict reads this
+                dead_for = time.time() - st.epoch_wall
+                self._epoch = time.perf_counter() - max(0.0, dead_for)
         # fleet-level request tracing: the router records the dispatch /
         # failover edges (each replica keeps its own per-stage timeline);
         # a merged view carries BOTH replica ids across a failover
@@ -243,6 +276,11 @@ class Router:
         # set by enable_stream_progress (an SSE gateway exists): remote
         # replicas piggyback tokens-so-far on step replies
         self._stream_progress = False
+        if self._journal is not None and self._journal.recovered:
+            # cold-start recovery: the journal remembers what the dead
+            # control plane promised; the workers remember what they were
+            # doing. Reconcile the two before serving anything.
+            self._recover(self._journal.state)
         self.telemetry.gauge("router/replicas").set(rc.replicas)
         self._update_gauges()
         log_dist(
@@ -277,12 +315,20 @@ class Router:
                 return best
         return min(candidates, key=lambda r: (r.engine.load, r.rid))
 
-    def submit(self, request: Request) -> int:
+    def submit(self, request: Request, *,
+               idempotency_key: str | None = None) -> int:
         """Route a request to the best healthy replica. Raises typed
         ``RequestRejected`` when no replica accepts dispatch
         (``no_healthy_replicas``) or the GLOBAL arrived-queue bound is hit
         (``queue_full`` — or ``overloaded`` during brownout, the typed
         back-off hint); per-replica bounds may still reject underneath.
+
+        ``idempotency_key``: caller-supplied retry identity (the gateway's
+        ``X-DSTPU-Idempotency-Key``). Recorded in the journal's submit
+        record and in ``idempotency_lookup``, so a retried key maps back to
+        THIS uid — across a control-plane restart too — instead of forking
+        a second request. The caller consults ``idempotency_lookup`` BEFORE
+        submitting; this method does not dedup on its own.
 
         Brownout degradation ladder (docs/serving.md): deadline-free
         requests are tightened onto the brownout deadline; a full queue
@@ -364,12 +410,42 @@ class Router:
         self._owner[uid] = target.rid
         self._seen.setdefault(uid, set()).add(target.rid)
         self._requests[uid] = request
+        if idempotency_key:
+            self._idem[str(idempotency_key)] = uid
+        if self._journal is not None:
+            # the accept boundary: dispatch succeeded, so this request is
+            # PROMISED — the journal learns it before the caller does. (A
+            # crash in the window between the worker's accept and this
+            # append leaves only an orphan the owner map never points to,
+            # the documented lost-reply semantics.)
+            self._journal.record_submit(request, key=idempotency_key)
         target.dispatched += 1
         tm.counter("router/dispatched").inc()
         if self.tracer is not None:
             self.tracer.record(uid, "dispatched", to_replica=target.rid)
         self._update_gauges()
         return uid
+
+    def idempotency_lookup(self, key: str) -> Optional[int]:
+        """The uid an idempotency key already maps to (None if never
+        seen) — journal-backed, so the mapping survives a restart."""
+        return self._idem.get(str(key))
+
+    def idempotency_map(self) -> dict[str, int]:
+        """A copy of the full key -> uid mapping (the gateway seeds its
+        own cache from this after a recovery)."""
+        return dict(self._idem)
+
+    def max_uid_in_band(self, lo: int, hi: int) -> int:
+        """Highest uid in ``[lo, hi)`` this router knows (live or
+        terminal), or ``lo`` when none — a restarted gateway resumes its
+        uid counter PAST the recovered band instead of re-minting uids the
+        journal already owns."""
+        best = int(lo)
+        for uid in itertools.chain(self._owner, self._results):
+            if lo <= uid < hi:
+                best = max(best, uid)
+        return best
 
     def cancel(self, uid: int) -> bool:
         """Cancel wherever the request lives; the terminal ``cancelled``
@@ -382,6 +458,11 @@ class Router:
         r = self._replicas[rid]
         if not r.engine.cancel(uid):
             return False
+        if self._journal is not None:
+            # the cancel record covers the crash window before the
+            # terminal lands: a replay without the result still knows the
+            # user cancelled — the uid is never re-dispatched
+            self._journal.record_cancel(uid)
         self._record(r, uid)
         self._pending_terminal.append(uid)
         return True
@@ -519,6 +600,99 @@ class Router:
                  f"(supervisor observed the worker process gone)", ranks=[0])
         self._fail(r, "dead", self.now(), self._pending_terminal)
 
+    # -- cold-start recovery (docs/serving.md "Crash-safe control plane") -
+
+    def _recover(self, st) -> None:
+        """Rebuild the owner map after a control-plane crash: replay the
+        journal's terminals into ``_results``, then one reconcile round
+        against every replica — a worker that survived the crash still
+        holds its live requests and its UNACKED terminal results (the PR 8
+        replay-safe buffers), so nothing it knows is lost and nothing it
+        holds runs twice. Journaled-accepted uids NOBODY accounts for
+        (their worker died between crash and restart, or the crash landed
+        between journal append and worker dispatch loss) re-dispatch
+        through the existing exactly-once failover path."""
+        from .rpc import decode_request, decode_result
+
+        tm = self.telemetry
+        tm.counter("router/recovery/recoveries").inc()
+        self._idem.update(st.idem)
+        for uid, t in st.terminals.items():
+            if t.get("res") is not None and uid not in self._results:
+                self._results[uid] = decode_result(t["res"])
+                tm.counter("router/recovery/replayed_terminals").inc()
+        live_uids = sorted(st.requests)
+        held: dict[int, int] = {}       # uid -> rid still holding it live
+        harvested: dict[int, RequestResult] = {}
+        for r in self._replicas:
+            rec_fn = getattr(r.engine, "reconcile", None)
+            try:
+                if rec_fn is not None:
+                    out = rec_fn(live_uids)
+                    live = {int(u) for u in out.get("live", ())}
+                    results = {int(u): res
+                               for u, res in (out.get("results") or {}).items()}
+                else:
+                    # in-process replica: the same questions over the
+                    # generic scheduler surface
+                    live = {int(q.uid) for q in r.engine.live_requests()}
+                    results = {}
+                    for uid in live_uids:
+                        res = r.engine.result(uid)
+                        if res is not None:
+                            results[uid] = res
+            except (RpcError, OSError) as e:
+                # a worker that died between crash and restart cannot be
+                # reconciled — its journaled requests fall through to the
+                # re-dispatch path below
+                log_dist(f"router: recovery reconcile with replica {r.rid} "
+                         f"failed ({type(e).__name__}: {e}) — its requests "
+                         f"fall through to failover", ranks=[0])
+                continue
+            for uid, res in results.items():
+                harvested.setdefault(uid, res)
+            for uid in live:
+                if uid in st.requests:
+                    held.setdefault(uid, r.rid)
+        redispatch: list[Request] = []
+        for uid in live_uids:
+            req = decode_request(st.requests[uid])
+            if uid in harvested:
+                # the worker finished it while the brain was dead (or the
+                # terminal's journal append was lost): harvest the unacked
+                # result, make it durable NOW
+                res = harvested[uid]
+                self._results[uid] = res
+                self._journal.record_terminal(uid, res)
+                self._pending_terminal.append(uid)
+                tm.counter("router/recovery/recovered_results").inc()
+            elif uid in held:
+                # still in flight on a surviving worker: adopt — rebuild
+                # the owner map entry, never re-dispatch (nothing runs
+                # twice)
+                rid = held[uid]
+                self._owner[uid] = rid
+                self._seen.setdefault(uid, set()).add(rid)
+                self._requests[uid] = req
+                self._replicas[rid].dispatched += 1
+                tm.counter("router/recovery/adopted_requests").inc()
+            else:
+                redispatch.append(req)
+        for req in redispatch:
+            # accepted, unaccounted: the existing exactly-once failover
+            # path re-queues it on a clean replica (or fails it with a
+            # typed terminal when none is left) — zero silent loss
+            self._requests[req.uid] = req
+            self._failover(req, self._pending_terminal)
+            tm.counter("router/recovery/redispatched").inc()
+        self._update_gauges()
+        log_dist(
+            f"router: recovered from journal — "
+            f"{len(st.terminals)} journaled terminals, "
+            f"{len(held)} adopted in flight, "
+            f"{len(harvested)} results harvested from workers, "
+            f"{len(redispatch)} re-dispatched", ranks=[0])
+
     # -- health / failover ----------------------------------------------
 
     def _record(self, r: _Replica, uid: int) -> None:
@@ -530,6 +704,8 @@ class Router:
         del self._owner[uid]
         self._seen.pop(uid, None)
         self._requests.pop(uid, None)
+        if self._journal is not None:
+            self._journal.record_terminal(uid, res)
 
     def _collect(self, r: _Replica, uids, terminal: list) -> None:
         for uid in uids:
@@ -545,6 +721,10 @@ class Router:
             arrival_time=req.arrival_time, finish_time=now, status=status)
         self._results[req.uid] = res
         self._requests.pop(req.uid, None)
+        if self._journal is not None:
+            # skips uids the journal never accepted (a shed submit's
+            # synthesized result) — record_terminal filters those
+            self._journal.record_terminal(req.uid, res)
         self.telemetry.emit({
             "type": "request", "uid": req.uid, "slot": -1,
             "prompt_len": res.prompt_len, "n_tokens": 0, "status": status,
@@ -700,6 +880,12 @@ class Router:
             now = time.perf_counter() - self._epoch
         tm = self.telemetry
         self._steps += 1
+        if self._inj is not None and self._inj.router_crash(self._steps):
+            # the control plane "dies" here: typed, pre-work, so the
+            # journal holds exactly what a SIGKILL would have left behind
+            tm.counter("resilience/injected_faults").inc()
+            raise ControlPlaneCrash(
+                f"fault injection: router_crash at router step {self._steps}")
         terminal: list[int] = self._pending_terminal
         self._pending_terminal = []
         for r in self._replicas:
@@ -943,7 +1129,10 @@ class Router:
 
     def rolling_upgrade(self, *, supervisor=None, slots: dict | None = None,
                         spawn=None, spec: dict | None = None,
-                        gate_timeout_s: float = 120.0) -> None:
+                        gate_timeout_s: float = 120.0,
+                        canary: bool = True,
+                        canary_prompt=None,
+                        canary_max_new: int = 2) -> None:
         """Begin a zero-downtime worker-by-worker fleet upgrade
         (docs/serving.md "HTTP front door & rolling upgrades"). For each
         replica that was healthy when the upgrade started, one WAVE:
@@ -952,15 +1141,24 @@ class Router:
              background thread — the fleet keeps stepping — or the
              ``spawn`` callable / the in-process builder, run inline),
           2. ``attach_replica`` it and GATE on its first healthy
-             non-compiling step (a newcomer that dies, hangs, or never
-             completes a clean step within ``gate_timeout_s`` ABORTS the
-             upgrade — the old generation keeps serving, the failed
-             newcomer is drained and its worker retired). KNOWN LIMIT:
-             during a traffic lull the gating step may be an idle one —
-             it proves the newcomer booted its engine and answers the
-             scheduler surface, not that it can serve load; a spec that
-             only fails under real work passes the gate (a canary
-             request per wave is the future strengthening),
+             non-compiling step PLUS — with ``canary`` on, the default — a
+             synthetic CANARY generate served end-to-end by the newcomer:
+             a tiny request in the RESERVED uid band (>= 2^62; never
+             journaled — it bypasses ``Router.submit`` — and never traced
+             as user traffic), submitted directly to the newcomer's
+             scheduler and driven by the ordinary fleet steps. This closes
+             the documented idle-step limitation of the original gate: a
+             lull-time step proved only that the newcomer booted and
+             answers the scheduler surface; the canary proves it can
+             PREFILL, DECODE and finish a request before the proven old
+             generation is drained. A newcomer that dies, hangs, fails its
+             canary, or never completes the gate within ``gate_timeout_s``
+             ABORTS the upgrade — the old generation keeps serving, the
+             failed newcomer is drained and its worker retired.
+             ``canary_prompt`` (default ``[1, 1, 1, 1]``) must be valid
+             token ids for the spec's vocab; pass a workload-shaped prompt
+             to keep compiled program shapes warm under watchdog raise.
+             ``canary=False`` restores the idle-step-only gate,
           3. only then ``drain_replica`` the old generation (queued work
              migrates, in-flight streams finish in place — zero accepted
              requests lost) and retire its worker slot.
@@ -978,11 +1176,13 @@ class Router:
         self.telemetry.counter("router/upgrades").inc()
         self._upgrade = _RollingUpgrade(
             self, supervisor=supervisor, slots=slots, spawn=spawn,
-            spec=spec, gate_timeout_s=gate_timeout_s)
+            spec=spec, gate_timeout_s=gate_timeout_s, canary=canary,
+            canary_prompt=canary_prompt, canary_max_new=canary_max_new)
         log_dist(
             f"router: rolling upgrade started over replicas "
-            f"{self._upgrade.plan} (gate: first healthy non-compiling "
-            f"step, {gate_timeout_s}s timeout)", ranks=[0])
+            f"{self._upgrade.plan} (gate: first healthy non-compiling step"
+            f"{' + served canary' if canary else ''}, "
+            f"{gate_timeout_s}s timeout)", ranks=[0])
 
     def upgrade_status(self) -> Optional[dict]:
         """State of the current/last rolling upgrade (None if never
@@ -1095,12 +1295,19 @@ class _RollingUpgrade:
 
     def __init__(self, router: Router, *, supervisor=None,
                  slots: dict | None = None, spawn=None,
-                 spec: dict | None = None, gate_timeout_s: float = 120.0):
+                 spec: dict | None = None, gate_timeout_s: float = 120.0,
+                 canary: bool = True, canary_prompt=None,
+                 canary_max_new: int = 2):
         self.router = router
         self.supervisor = supervisor
         self.slots: dict[int, int] = dict(slots or {})  # rid -> slot
         self._spawn_fn = spawn
         self.gate_timeout_s = float(gate_timeout_s)
+        self.canary = bool(canary)
+        self.canary_prompt = (np.asarray([1, 1, 1, 1], np.int32)
+                              if canary_prompt is None
+                              else np.asarray(canary_prompt, np.int32))
+        self.canary_max_new = int(canary_max_new)
         self.state = "running"
         self.reason = ""
         self.plan: list[int] = [r.rid for r in router._replicas
@@ -1217,15 +1424,59 @@ class _RollingUpgrade:
                 self._abort(now, f"newcomer replica {w['new_rid']} died "
                             "before its first healthy step")
                 return
-            if new_r.state == "healthy" and new_r.ok_steps >= 1:
-                # newcomer proven: NOW the old generation may go
+            if self.canary and w.get("canary_uid") is None:
+                # per-wave canary: a tiny generate in the RESERVED uid
+                # band submitted DIRECTLY to the newcomer's scheduler — it
+                # bypasses Router.submit, so it is never journaled, never
+                # dispatched elsewhere, and the tracer band filter keeps
+                # it out of user timelines. The ordinary fleet steps drive
+                # it; a newcomer that cannot serve it cannot serve users.
+                uid = RESERVED_UID_BASE + next(_canary_uids)
+                try:
+                    # deadline-bounded: an abort drains the newcomer, and
+                    # a canary it can never serve must not pin that drain
+                    # open forever (the deadline sweep frees the slot).
+                    # arrival_time is NOW on the fleet clock (the newcomer
+                    # was set_epoch'd at attach): deadlines are absolute
+                    # (arrival_time + deadline_s), so a 0.0 arrival on a
+                    # fleet older than gate_timeout_s would be expired at
+                    # submit and every upgrade would spuriously abort
+                    new_r.engine.submit(Request(
+                        uid=uid, prompt=self.canary_prompt,
+                        max_new_tokens=self.canary_max_new,
+                        arrival_time=now,
+                        deadline_s=max(1.0, self.gate_timeout_s)))
+                except (RpcError, OSError, ValueError) as e:
+                    self._abort(now, f"newcomer replica {w['new_rid']} "
+                                f"refused its canary generate "
+                                f"({type(e).__name__}: {e})")
+                    return
+                w["canary_uid"] = uid
+            canary_ok = True
+            if self.canary:
+                try:
+                    res = new_r.engine.result(w["canary_uid"])
+                except (RpcError, OSError):
+                    res = None  # transport hiccup: the timeout governs
+                if res is not None and not res.ok:
+                    self._abort(now, f"newcomer replica {w['new_rid']} "
+                                f"failed its canary generate "
+                                f"(status {res.status})")
+                    return
+                canary_ok = res is not None and res.ok
+                if canary_ok:
+                    w["canary_status"] = res.status
+            if new_r.state == "healthy" and new_r.ok_steps >= 1 and canary_ok:
+                # newcomer proven — booted, stepped clean, AND served a
+                # request end-to-end: NOW the old generation may go
                 self.router.drain_replica(w["old_rid"], block=False)
                 w["phase"] = "drain"
                 return
             if now - w["gate_start"] > self.gate_timeout_s:
                 self._abort(now, f"newcomer replica {w['new_rid']} never "
-                            "completed a healthy non-compiling step within "
-                            f"{self.gate_timeout_s}s")
+                            "passed the gate (healthy non-compiling step"
+                            + (" + served canary" if self.canary else "")
+                            + f") within {self.gate_timeout_s}s")
             return
         if w["phase"] in ("drain", "abort_drain"):
             rid = w["old_rid"] if w["phase"] == "drain" else w["new_rid"]
@@ -1277,6 +1528,16 @@ class _RollingUpgrade:
         log_dist(f"router: rolling upgrade ABORTED — {reason} (old "
                  "generation keeps serving)", ranks=[0])
         w = self._wave
+        if w and w.get("canary_uid") is not None \
+                and w.get("new_rid") is not None:
+            # free the pending canary so the newcomer's abort-drain is
+            # not pinned open by a request it can never serve
+            try:
+                self.router._replicas[w["new_rid"]].engine.cancel(
+                    w["canary_uid"])
+            except (RpcError, OSError):
+                pass  # a dead/hung newcomer cannot acknowledge; its
+                #       slot dies with the process anyway
         self._retire_slot(boot_slot)
         new_rid = w.get("new_rid") if w else None
         if new_rid is not None and \

@@ -725,6 +725,22 @@ class ReplicaClient:
             return []
         return [decode_request(d) for d in reply]
 
+    def reconcile(self, uids: list) -> dict:
+        """The restart-recovery round trip (``Router._recover``): which of
+        the journaled ``uids`` this worker still holds live, plus every
+        terminal result it has for them (the replay-safe unacked buffer's
+        contents survive a router crash). Raises on transport failure —
+        the Router treats an unreconcilable worker as dead-between-crash-
+        and-restart and fails its requests over."""
+        reply = self.rpc.call("reconcile", uids=[int(u) for u in uids],
+                              retry_safe=True)
+        self._refresh(reply)
+        results = {int(u): decode_result(enc)
+                   for u, enc in (reply.get("results") or {}).items()}
+        self._results.update(results)
+        return {"live": [int(u) for u in reply.get("live") or []],
+                "results": results}
+
     def arrived_queue_len(self, now: float | None = None) -> int:
         try:
             self._arrived = int(self.rpc.call(

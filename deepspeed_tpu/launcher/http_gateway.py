@@ -14,10 +14,22 @@ one ``Router``:
     ``Request.deadline_s`` — the brownout ladder and the deadline sweeps
     see HTTP traffic exactly as they see in-process submits. With
     ``stream`` (the default) the response is Server-Sent Events: one
-    ``token`` event per generated token off the Router's incremental
-    ``partial_result`` surface, then one ``done`` event carrying the
-    authoritative terminal result. ``"stream": false`` waits and returns
-    one JSON document.
+    ``token`` event per generated token (each carrying an ``id:`` line
+    with the token index) off the Router's incremental ``partial_result``
+    surface, then one ``done`` event carrying the authoritative terminal
+    result. ``"stream": false`` waits and returns one JSON document.
+  * session resume (docs/serving.md "Crash-safe control plane") — an
+    ``X-DSTPU-Idempotency-Key`` header makes the submit retry-safe: the
+    key maps durably (via the Router's request journal) to the uid it
+    first minted, so a client that lost its connection — or rode out a
+    whole gateway/router restart — retries the SAME request and gets the
+    SAME uid back, never a forked duplicate; a key whose request already
+    finished replays the journaled terminal result. Pair it with
+    ``Last-Event-ID: <n>`` (the SSE id of the last token received) and
+    the re-streamed response resumes at token ``n+1`` from the per-uid
+    progress cache, so the client sees ONE bitwise-identical token
+    stream across the reconnect (greedy decoding replays the identical
+    prefix).
   * overload → HTTP semantics — typed ``RequestRejected`` reasons map to
     distinct statuses: ``queue_full``/``overloaded`` → 429 (brownout's
     ``overloaded`` tells clients to back off; both carry ``Retry-After``
@@ -172,6 +184,18 @@ class HttpGateway:
                if str(gateway_id).isdigit() and int(gateway_id) < 0x10000
                else 0x10000 | (zlib.crc32(str(gateway_id).encode()) & 0xFFFF))
         self._uid = gid << 32
+        # a RESTARTED gateway over a journal-recovered Router resumes its
+        # uid counter past the recovered band (re-minting a journaled uid
+        # would trip the fleet-wide duplicate-uid guard) and seeds the
+        # idempotency map from the journal so retried keys replay instead
+        # of forking fresh uids
+        band_max = getattr(router, "max_uid_in_band", None)
+        if band_max is not None:
+            self._uid = max(self._uid, band_max(gid << 32, (gid + 1) << 32))
+        self._idem: dict[str, int] = {}
+        idem_map = getattr(router, "idempotency_map", None)
+        if idem_map is not None:
+            self._idem.update(idem_map())
         self._draining = False
         self._stopped = False
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -273,8 +297,22 @@ class HttpGateway:
                 cmd["event"].set()
                 continue
             if op == "submit":
+                key = cmd.get("idem")
+                if key and self._replay_idempotent(cmd, key):
+                    if cmd.get("abandoned") and cmd.get("fresh_stream"):
+                        # the handler already 503'd and nobody else reads
+                        # this feed: drop it (the REQUEST lives on — it
+                        # was accepted in a previous life and another
+                        # retry may still claim it; only the feed goes)
+                        self._close_stream(cmd["uid"])
+                        del cmd["stream"]
+                    cmd["event"].set()
+                    continue
                 try:
-                    uid = self.router.submit(cmd["request"])
+                    kw = {"idempotency_key": key} if key else {}
+                    uid = self.router.submit(cmd["request"], **kw)
+                    if key:
+                        self._idem[key] = uid
                     stream = _Stream(uid)
                     with self._lock:
                         self._streams[uid] = stream
@@ -307,6 +345,49 @@ class HttpGateway:
                         "gateway/cancelled_on_disconnect").inc()
                 self._close_stream(cmd["uid"])
             cmd["event"].set()
+
+    def _replay_idempotent(self, cmd: dict, key: str) -> bool:
+        """Serve-loop side of the idempotency contract: a key that already
+        maps to a uid NEVER submits again — the handler is attached to the
+        existing stream (two concurrent retries share one feed, each with
+        its own send cursor), or a fresh feed pre-filled from the fleet's
+        progress cache / the journaled terminal result. False when the key
+        is unseen (the caller submits normally)."""
+        uid = self._idem.get(key)
+        if uid is None:
+            lookup = getattr(self.router, "idempotency_lookup", None)
+            if lookup is not None:
+                uid = lookup(key)
+            if uid is None:
+                return False
+            self._idem[key] = uid
+        with self._lock:
+            stream = self._streams.get(uid)
+            if stream is None:
+                stream = _Stream(uid)
+                self._streams[uid] = stream
+                fresh = True
+            else:
+                fresh = False
+        if fresh:
+            pr = self.router.partial_result(uid)
+            if pr is not None:
+                stream.publish(pr[0], pr[1])
+            else:
+                res = self.router.result(uid)
+                if res is not None:
+                    stream.publish(None, res)
+                else:
+                    # the fleet genuinely forgot the uid (terminal aged
+                    # out of the journal's keep window): fail the feed so
+                    # the handler answers instead of hanging
+                    stream.fail()
+        cmd["stream"] = stream
+        cmd["uid"] = uid
+        cmd["replayed"] = True
+        cmd["fresh_stream"] = fresh
+        self.telemetry.counter("gateway/idempotent_replays").inc()
+        return True
 
     def _close_stream(self, uid: int) -> None:
         with self._lock:
@@ -491,9 +572,14 @@ def _make_handler(gw: HttpGateway):
             self.end_headers()
             self.wfile.write(payload)
 
-        def _sse_event(self, event: str, data: dict) -> None:
+        def _sse_event(self, event: str, data: dict,
+                       event_id: int | None = None) -> None:
+            # the id: line is the SSE-standard resume cursor: a client
+            # reconnecting with Last-Event-ID <id> resumes AFTER it
+            head = f"id: {event_id}\n" if event_id is not None else ""
             self.wfile.write(
-                f"event: {event}\ndata: {json.dumps(data)}\n\n".encode())
+                f"{head}event: {event}\ndata: {json.dumps(data)}\n\n"
+                .encode())
             self.wfile.flush()
 
         # -- routes ------------------------------------------------------
@@ -540,7 +626,8 @@ def _make_handler(gw: HttpGateway):
                 self._reply_json(404, {"error": f"unknown path {self.path}"})
                 return
             try:
-                req, stream_mode = self._parse_generate()
+                req, stream_mode, idem_key, resume_from = \
+                    self._parse_generate()
             except _HttpError as e:
                 gw.telemetry.counter("gateway/bad_requests").inc()
                 self._reply_json(e.status, {"error": e.message})
@@ -554,7 +641,8 @@ def _make_handler(gw: HttpGateway):
                                  {"Retry-After": gw.retry_after_s()})
                 return
             t0 = time.monotonic()
-            cmd = gw._command({"op": "submit", "request": req})
+            cmd = gw._command({"op": "submit", "request": req,
+                               "idem": idem_key})
             gw.telemetry.histogram("gateway/submit_wait_sec").observe(
                 time.monotonic() - t0)
             err = cmd.get("error")
@@ -562,10 +650,15 @@ def _make_handler(gw: HttpGateway):
                 self._reply_rejected(req, err)
                 return
             stream = cmd["stream"]
+            # a replayed idempotency key serves the ORIGINAL uid, never a
+            # fork; resume-from only makes sense on a replayed stream
+            uid = int(cmd.get("uid", req.uid))
+            if not cmd.get("replayed"):
+                resume_from = 0
             if stream_mode:
-                self._stream_sse(req, stream)
+                self._stream_sse(uid, stream, start_from=resume_from)
             else:
-                self._reply_blocking(req, stream)
+                self._reply_blocking(uid, stream)
 
         # -- request parsing ---------------------------------------------
 
@@ -614,7 +707,19 @@ def _make_handler(gw: HttpGateway):
                 )
             except (TypeError, ValueError) as e:
                 raise _HttpError(400, f"bad request field: {e}") from e
-            return req, bool(body.get("stream", True))
+            idem_key = (self.headers.get("X-DSTPU-Idempotency-Key")
+                        or "").strip() or None
+            resume_from = 0
+            last_id = (self.headers.get("Last-Event-ID") or "").strip()
+            if last_id:
+                try:
+                    resume_from = int(last_id) + 1  # resume AFTER that id
+                except ValueError as e:
+                    raise _HttpError(
+                        400, f"malformed Last-Event-ID header: {e}") from e
+                if resume_from < 0:
+                    raise _HttpError(400, "Last-Event-ID must be >= 0")
+            return req, bool(body.get("stream", True)), idem_key, resume_from
 
         def _reply_rejected(self, req, err) -> None:
             gw.telemetry.counter("gateway/rejected").inc()
@@ -631,7 +736,7 @@ def _make_handler(gw: HttpGateway):
 
         # -- response modes ----------------------------------------------
 
-        def _reply_blocking(self, req, stream: _Stream) -> None:
+        def _reply_blocking(self, uid: int, stream: _Stream) -> None:
             """``"stream": false``: wait for the terminal result, reply
             with one JSON document. No mid-flight disconnect detection
             here — nothing is written until the request is terminal, so a
@@ -644,23 +749,30 @@ def _make_handler(gw: HttpGateway):
                     if gw._stopped:
                         break
                 res = stream.result
-            gw._close_stream(req.uid)
+            gw._close_stream(uid)
             if res is None:
                 self._reply_json(503, {"error": "gateway stopped before "
                                        "the request finished",
-                                       "uid": req.uid})
+                                       "uid": uid})
                 return
-            self._reply_json(200, _result_json(req.uid, res))
-            gw.tracer.record(req.uid, "stream_done",
+            self._reply_json(200, _result_json(uid, res))
+            gw.tracer.record(uid, "stream_done",
                              status=res.status, n_tokens=len(res.tokens))
             gw.telemetry.counter("gateway/streams_done").inc()
 
-        def _stream_sse(self, req, stream: _Stream) -> None:
+        def _stream_sse(self, uid: int, stream: _Stream,
+                        start_from: int = 0) -> None:
             """SSE mode: one ``token`` event per generated token as the
-            feed advances, keepalive comments while idle, a final ``done``
+            feed advances (``id:`` = token index, the ``Last-Event-ID``
+            cursor space), keepalive comments while idle, a final ``done``
             event; ANY write failure (gone client, stalled reader past the
-            write deadline) cancels the request fleet-side."""
-            uid = req.uid
+            write deadline) cancels the request fleet-side.
+
+            ``start_from`` (a replayed idempotency key + ``Last-Event-ID``)
+            resumes mid-stream: tokens below it were delivered in a
+            previous connection — possibly to a previous gateway PROCESS —
+            and are skipped, so the client's concatenated view is one
+            bitwise-identical stream."""
             # the slow-reader deadline: a client that stops draining its
             # socket turns the next send into a timeout, which is treated
             # exactly like a disconnect. 0 genuinely DISABLES it — the
@@ -670,8 +782,11 @@ def _make_handler(gw: HttpGateway):
                 gw.cfg.write_timeout_s if gw.cfg.write_timeout_s > 0
                 else None)
             t_start = time.monotonic()
-            sent = 0
+            sent = int(start_from)
             started = False
+            if sent > 0:
+                gw.telemetry.counter("gateway/resumed_streams").inc()
+                gw.tracer.record(uid, "stream_resumed", from_token=sent)
             last_write = time.monotonic()
             try:
                 self.send_response(200)
@@ -687,7 +802,8 @@ def _make_handler(gw: HttpGateway):
                         toks = list(stream.tokens)
                         done, res = stream.done, stream.result
                     for tok in toks[sent:]:
-                        self._sse_event("token", {"i": sent, "token": tok})
+                        self._sse_event("token", {"i": sent, "token": tok},
+                                        event_id=sent)
                         sent += 1
                         last_write = time.monotonic()
                         if not started:

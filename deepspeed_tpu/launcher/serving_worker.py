@@ -71,6 +71,7 @@ from ..resilience.heartbeat import HeartbeatJudge
 from ..resilience.preemption import PreemptionGuard
 from ..resilience.retry import RetryPolicy, backoff_delay
 from ..runtime.config import RouterTransportConfig
+from ..utils.durability import write_durable_bytes
 from ..utils.logging import logger
 
 
@@ -225,6 +226,21 @@ class WorkerHost:
     def live_requests(self) -> list:
         return [encode_request(r) for r in self.engine.live_requests()]
 
+    def reconcile(self, uids) -> dict:
+        """One recovery round trip (``Router._recover``): for the
+        journaled non-terminal ``uids`` a restarted control plane asks
+        about, report which this worker still holds LIVE and every
+        terminal result it has for them — the unacked-result buffer and
+        the engine's result map both survive a ROUTER crash, since only
+        the router process died. Read-only and replay-safe."""
+        results = {}
+        for u in uids or []:
+            res = self.engine.result(int(u))
+            if res is not None:
+                results[str(int(u))] = encode_result(res)
+        live = [int(r.uid) for r in self.engine.live_requests()]
+        return {"live": live, "results": results, **self._state()}
+
     def arrived_queue_len(self, now=None) -> int:
         return self.engine.arrived_queue_len(
             None if now is None else float(now))
@@ -257,9 +273,54 @@ class WorkerHost:
     def handlers(self) -> dict:
         return {name: getattr(self, name) for name in (
             "ping", "submit", "requeue", "withdraw", "cancel", "result",
-            "step", "live_requests", "arrived_queue_len", "prefix_match_len",
-            "set_epoch", "drain", "telemetry_snapshot", "compile_counts",
-            "prefix_cache_stats")}
+            "step", "live_requests", "reconcile", "arrived_queue_len",
+            "prefix_match_len", "set_epoch", "drain", "telemetry_snapshot",
+            "compile_counts", "prefix_cache_stats")}
+
+
+def _pid_alive(pid: int) -> bool:
+    """Liveness probe (signal 0). EPERM means alive-but-not-ours — still
+    alive for the purposes of never SIGKILLing a recycled pid."""
+    try:
+        os.kill(int(pid), 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return True
+
+
+class _AdoptedProc:
+    """Popen-shaped handle for a worker ADOPTED from a dead predecessor
+    supervisor's pidfile: the process is not our child, so there is no
+    real returncode — ``poll`` degrades to the pid-liveness probe and a
+    vanished process reports the conventional ``-SIGKILL``. ``wait`` is a
+    bounded poll loop (retire/shutdown paths); ``kill`` delivers the
+    signal directly."""
+
+    def __init__(self, pid: int):
+        self.pid = int(pid)
+        self.returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is None and not _pid_alive(self.pid):
+            self.returncode = -signal.SIGKILL  # true rc unknowable: not our child
+        return self.returncode
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired(
+                    f"adopted worker pid {self.pid}", timeout)
+            time.sleep(0.05)
+        return self.returncode
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
 
 
 def main(argv=None) -> int:
@@ -415,12 +476,26 @@ class WorkerSupervisor:
         retire→spawn wave boots the new one. Durable write (tmp + fsync +
         rename) so a crash mid-upgrade never leaves a torn spec for the
         next respawn to boot from."""
-        tmp = self.spec_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(spec, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.spec_path)
+        write_durable_bytes(self.spec_path,
+                            json.dumps(spec).encode("utf-8"))
+
+    def _pidfile(self, slot: int) -> str:
+        return os.path.join(self.workdir, f"w{slot}.pid")
+
+    def _write_pidfile(self, slot: int, info: dict) -> None:
+        """Per-slot pidfile, written tmp + fsync + rename (+ dir fsync):
+        the adoption record a RESTARTED supervisor reads to find workers
+        that survived the control plane's death. A torn pidfile would be
+        adopted as garbage or reaped as stale — durability is the hygiene
+        here, same discipline as ``set_spec``."""
+        write_durable_bytes(self._pidfile(slot),
+                            json.dumps(info).encode("utf-8"))
+
+    def _remove_pidfile(self, slot: int) -> None:
+        try:
+            os.unlink(self._pidfile(slot))
+        except OSError:
+            pass
 
     def _listen_address(self, slot: int) -> str:
         """The address the slot's NEXT generation binds: a per-generation
@@ -478,6 +553,12 @@ class WorkerSupervisor:
                                     stderr=subprocess.STDOUT,
                                     start_new_session=True)
         self._procs[slot] = proc
+        # adoption record FIRST (pid + declared address): a control-plane
+        # crash during boot must not leave an untracked orphan; the
+        # resolved address is rewritten below once the worker is up
+        self._write_pidfile(slot, {
+            "pid": proc.pid, "slot": slot, "gen": self._gen[slot],
+            "addr": addr, "heartbeat": hb, "log": log_path})
         # an ephemeral-port worker resolves its address at bind time; poll
         # the ready line for it before the first connect
         ephemeral = addr.startswith("tcp://") and addr.endswith(":0")
@@ -509,9 +590,107 @@ class WorkerSupervisor:
             except RpcConnectionLost:
                 time.sleep(0.1)
         self._clients[slot] = client
+        if client.rpc.path != addr:
+            # ephemeral TCP port resolved at bind time: the adoption
+            # record must carry the address a successor can connect to
+            self._write_pidfile(slot, {
+                "pid": proc.pid, "slot": slot, "gen": self._gen[slot],
+                "addr": client.rpc.path, "heartbeat": hb, "log": log_path})
         logger.info("serving supervisor: slot %d generation %d up (pid %d, "
                     "%s)", slot, self._gen[slot], proc.pid, client.rpc.path)
         return client
+
+    # -- orphan adoption (docs/serving.md "Crash-safe control plane") ----
+
+    def adopt(self) -> dict[int, ReplicaClient]:
+        """Adopt still-running workers a DEAD predecessor supervisor left
+        behind, from the fsync'd per-slot pidfiles in ``workdir`` — a
+        restarted control plane re-attaches surviving workers instead of
+        double-spawning onto their ports/sockets.
+
+        Hygiene rules (the recycled-pid hazard): a pidfile whose pid is
+        dead is STALE and reaped (unlinked); a pid that is alive must ALSO
+        prove identity — the recorded RPC address answers ``ping`` with
+        the recorded pid — before adoption. A recycled pid that merely
+        exists (or an unrelated process squatting the address) fails the
+        identity check and only the FILE is reaped: this supervisor never
+        signals a pid it cannot prove is its worker. Returns
+        ``{slot: ReplicaClient}`` for every adopted worker; missing slots
+        are the caller's to ``spawn()``."""
+        adopted: dict[int, ReplicaClient] = {}
+        try:
+            names = sorted(os.listdir(self.workdir))
+        except OSError:
+            return adopted
+        for name in names:
+            if not (name.startswith("w") and name.endswith(".pid")):
+                continue
+            path = os.path.join(self.workdir, name)
+            try:
+                with open(path) as f:
+                    info = json.load(f)
+                slot = int(info["slot"])
+                pid = int(info["pid"])
+                addr = str(info["addr"])
+            except (OSError, ValueError, KeyError, TypeError):
+                logger.warning("serving supervisor: unreadable pidfile %s "
+                               "— reaping", path)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            if slot in self._procs:
+                continue  # this supervisor already owns the slot
+            if not _pid_alive(pid):
+                logger.info("serving supervisor: stale pidfile %s (pid %d "
+                            "dead) — reaped", path, pid)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            # liveness is not identity: prove over the RPC socket that
+            # the live pid IS our worker before supervising (or ever
+            # signalling) it
+            client = ReplicaClient(addr, replica_id=slot,
+                                   transport=self.transport,
+                                   seed=self.seed * 1009 + slot)
+            try:
+                reply = client.ping()
+                verified = int(reply.get("pid", -1)) == pid
+            except (RpcError, OSError):
+                verified = False
+            if not verified:
+                client.close()
+                logger.warning(
+                    "serving supervisor: pidfile %s names live pid %d but "
+                    "%s does not answer as it — recycled pid or squatted "
+                    "address; reaping the FILE, never the pid", path, pid,
+                    addr)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            self._procs[slot] = _AdoptedProc(pid)
+            self._clients[slot] = client
+            self._gen[slot] = int(info.get("gen", 0))
+            self._logs[slot] = str(info.get("log", "")) or os.path.join(
+                self.workdir, f"w{slot}g{self._gen[slot]}.log")
+            hb = str(info.get("heartbeat", "")) or os.path.join(
+                self.workdir, f"hb{slot}")
+            self._hb_path[slot] = hb
+            judge = HeartbeatJudge(
+                hb, float(self.transport.heartbeat_timeout_s))
+            judge.reset()
+            self._hb_judge[slot] = judge
+            self._heal_anchor[slot] = self._now()
+            adopted[slot] = client
+            logger.info("serving supervisor: ADOPTED slot %d (pid %d, %s, "
+                        "generation %d) from a previous supervisor",
+                        slot, pid, addr, self._gen[slot])
+        return adopted
 
     def start(self) -> list[ReplicaClient]:
         return [self.spawn(slot) for slot in range(self.n_workers)]
@@ -612,6 +791,7 @@ class WorkerSupervisor:
         self._hb_judge.pop(slot, None)
         self._hb_path.pop(slot, None)
         self._heal_anchor.pop(slot, None)
+        self._remove_pidfile(slot)
         if client is not None:
             client.close()
         if proc is None:
@@ -649,6 +829,9 @@ class WorkerSupervisor:
         for client in self._clients.values():
             client.close()
         self._clients.clear()
+        for slot in list(self._procs):
+            # the workers are down: their adoption records are stale now
+            self._remove_pidfile(slot)
 
 
 if __name__ == "__main__":

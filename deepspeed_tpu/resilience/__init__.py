@@ -24,6 +24,8 @@ from .errors import (
     CheckpointCorruptError,
     CheckpointError,
     CheckpointNotFoundError,
+    ControlPlaneCrash,
+    JournalCorruptError,
     PreemptionSignal,
     RequestRejected,
     ResilienceError,
@@ -52,8 +54,10 @@ __all__ = [
     "CheckpointCorruptError",
     "CheckpointError",
     "CheckpointNotFoundError",
+    "ControlPlaneCrash",
     "FaultInjector",
     "HeartbeatJudge",
+    "JournalCorruptError",
     "PreemptionGuard",
     "PreemptionSignal",
     "RequestRejected",

@@ -75,6 +75,30 @@ class PermanentIOError(OSError):
     on attempt 2 (indistinguishable from ``io_flaky``)."""
 
 
+class JournalCorruptError(ResilienceError):
+    """The request journal (``inference/journal.py``) holds a record whose
+    frame fails its magic/CRC check with MORE valid data after it — bytes
+    were corrupted in place (bit rot, a torn overwrite), not merely torn at
+    the tail by a crash mid-append. A torn TAIL is expected (the crash the
+    journal exists to survive) and is silently truncated on replay; mid-file
+    corruption means the durable record of accepted requests cannot be
+    trusted and must surface as this typed error, never as a silent partial
+    replay."""
+
+    def __init__(self, message: str, path: str = "", offset: int = -1):
+        super().__init__(message)
+        self.path = path
+        self.offset = offset
+
+
+class ControlPlaneCrash(ResilienceError):
+    """Injected control-plane failure (the ``router_crash`` fault site): the
+    Router raises this at the armed step, modelling the gateway+router
+    process dying mid-traffic. Recovery tests abandon the raising Router and
+    rebuild one over the SAME replicas and journal — the in-process spelling
+    of the ``bench.py --router-chaos`` SIGKILL."""
+
+
 class RpcError(ResilienceError):
     """Base class for serving-RPC transport failures (``inference/rpc.py``).
     Stdlib-only like every other typed error here — the Router and the

@@ -64,6 +64,13 @@ Fault sites (see docs/resilience.md for where each is wired):
                       deadline (simulated as a send timeout). Same
                       containment contract as a disconnect — a slow reader
                       must not hold a slot or a handler thread hostage.
+  ``router_crash``    the CONTROL PLANE dies at a chosen router step:
+                      ``Router.step`` raises a typed ``ControlPlaneCrash``
+                      so recovery tests can abandon the Router mid-traffic
+                      and rebuild one over the same replicas + request
+                      journal — the deterministic in-process spelling of
+                      the ``bench.py --router-chaos`` gateway+router
+                      SIGKILL (inference/router.py consumes this).
 
 Two selection modes compose:
 
@@ -101,7 +108,7 @@ class FaultInjector:
     SITES = ("nan_grads", "io_error", "io_flaky", "garbage_logits", "preempt",
              "replica_dead", "replica_hang",
              "rpc_timeout", "rpc_conn_reset", "rpc_garbled_frame",
-             "gateway_disconnect", "gateway_stall")
+             "gateway_disconnect", "gateway_stall", "router_crash")
 
     def __init__(self, cfg: Any = None):
         self.enabled = bool(_get(cfg, "enabled", False)) if cfg is not None else False
@@ -136,6 +143,9 @@ class FaultInjector:
         self.gateway_stall_at = {
             tuple(int(x) for x in p)
             for p in _get(cfg, "gateway_stall_at", []) or []}
+        # control-plane crash: 1-based router steps (router_crash site)
+        self.router_crash_at = set(
+            _get(cfg, "router_crash_at", []) or [])
         self._writes = 0  # guarded-write clock (io_error site)
         self._fired: set = set()  # list-mode keys fire exactly once
         self._lock = threading.Lock()
@@ -285,6 +295,14 @@ class FaultInjector:
         return self._fire("gateway_stall",
                           (uid, token_n) in self.gateway_stall_at,
                           (uid, token_n))
+
+    def router_crash(self, step: int) -> bool:
+        """True if the control plane should crash (typed
+        ``ControlPlaneCrash`` out of ``Router.step``) at router step
+        ``step`` (1-based)."""
+        if not self.enabled:
+            return False
+        return self._fire("router_crash", step in self.router_crash_at, step)
 
     def stats(self) -> dict:
         return {

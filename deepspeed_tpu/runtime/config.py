@@ -352,6 +352,12 @@ class FaultInjectionConfig:
       reading (slow-reader write stall) — both must free the request's
       slot via ``Router.cancel`` (``launcher/http_gateway.py`` consumes
       these server-side; docs/resilience.md).
+    - ``router_crash_at``: 1-based router steps at which the control plane
+      "dies" — ``Router.step`` raises a typed ``ControlPlaneCrash`` so
+      in-process recovery tests can abandon the Router mid-traffic and
+      rebuild one over the same replicas + journal (the deterministic
+      spelling of the ``bench.py --router-chaos`` SIGKILL;
+      ``inference/router.py`` consumes this).
     - ``rate`` in [0, 1] with optional ``sites`` allowlist
       (``nan_grads`` | ``io_error`` | ``io_flaky`` | ``garbage_logits`` |
       ``preempt`` | ``replica_dead`` | ``replica_hang``).
@@ -375,6 +381,7 @@ class FaultInjectionConfig:
     rpc_garbled_at: list = field(default_factory=list)
     gateway_disconnect_at: list = field(default_factory=list)
     gateway_stall_at: list = field(default_factory=list)
+    router_crash_at: list = field(default_factory=list)
 
     def __post_init__(self):
         if not 0.0 <= self.rate <= 1.0:
@@ -389,7 +396,8 @@ class FaultInjectionConfig:
                                  "replica_dead", "replica_hang",
                                  "rpc_timeout", "rpc_conn_reset",
                                  "rpc_garbled_frame",
-                                 "gateway_disconnect", "gateway_stall"}
+                                 "gateway_disconnect", "gateway_stall",
+                                 "router_crash"}
         if bad:
             raise DeepSpeedConfigError(
                 f"fault_injection.sites contains unknown site(s) {sorted(bad)}")
@@ -415,6 +423,11 @@ class FaultInjectionConfig:
                     raise DeepSpeedConfigError(
                         f"fault_injection.{name} entries must be "
                         f"[uid, nth_token] int pairs, got {p!r}")
+        for s in self.router_crash_at:
+            if not isinstance(s, int) or s < 1:
+                raise DeepSpeedConfigError(
+                    f"fault_injection.router_crash_at entries must be "
+                    f"1-based router steps (positive ints), got {s!r}")
 
 
 @dataclass
@@ -651,6 +664,55 @@ class RouterHealthConfig:
             raise DeepSpeedConfigError(
                 f"serving.router.health.jitter must be in [0, 1], "
                 f"got {self.jitter}")
+
+
+@dataclass
+class JournalConfig:
+    """``serving.router.journal`` block (consumed by
+    ``inference/journal.RequestJournal`` via ``inference/router.Router``;
+    docs/serving.md "Crash-safe control plane").
+
+    The durable request journal that makes a control-plane (router/gateway)
+    crash a recoverable event: every ACCEPTED request is recorded (with its
+    idempotency key), every terminal result and cancel is recorded, and a
+    restarted Router replays the journal + reconciles against surviving
+    workers to rebuild its owner map with zero accepted-request loss.
+
+    - ``enabled``: write the journal and recover from it on cold start. A
+      disabled fleet constructs NO journal and pays ZERO new fsyncs on the
+      submit/terminal hot path.
+    - ``path``: the journal file. Rotation/compaction rewrites it with the
+      checkpoint saver's rename-durability discipline (tmp + fsync +
+      rename + directory fsync).
+    - ``fsync``: fsync after every appended record (the durability the
+      recovery proof rests on). False trades crash-durability of the last
+      few records for latency — replay still tolerates the torn tail.
+    - ``rotate_max_records``: appended records between compactions; past it
+      the journal is rewritten to live requests + retained terminals so an
+      always-on fleet's journal stays bounded.
+    - ``keep_terminals``: terminal records retained across compactions —
+      the idempotent-replay window (a retried idempotency key older than
+      this may be re-submitted as a fresh request).
+    """
+
+    enabled: bool = False
+    path: str = ""
+    fsync: bool = True
+    rotate_max_records: int = 4096
+    keep_terminals: int = 1024
+
+    def __post_init__(self):
+        if self.enabled and not self.path:
+            raise DeepSpeedConfigError(
+                "serving.router.journal.enabled requires journal.path")
+        if self.rotate_max_records < 2:
+            raise DeepSpeedConfigError(
+                f"serving.router.journal.rotate_max_records must be >= 2, "
+                f"got {self.rotate_max_records}")
+        if self.keep_terminals < 0:
+            raise DeepSpeedConfigError(
+                f"serving.router.journal.keep_terminals must be >= 0, "
+                f"got {self.keep_terminals}")
 
 
 @dataclass
@@ -902,6 +964,8 @@ class RouterConfig:
       (its own dataclass above; ignored by in-process fleets).
     - ``autoscale``: ledger-driven elastic scaling sub-block (its own
       dataclass above; docs/serving.md "Elastic fleet & brownout").
+    - ``journal``: durable request-journal sub-block (its own dataclass
+      above; docs/serving.md "Crash-safe control plane").
     """
 
     replicas: int = 1
@@ -911,6 +975,7 @@ class RouterConfig:
     transport: RouterTransportConfig = field(
         default_factory=RouterTransportConfig)
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
+    journal: JournalConfig = field(default_factory=JournalConfig)
 
     def __post_init__(self):
         if isinstance(self.health, dict):
@@ -919,6 +984,8 @@ class RouterConfig:
             self.transport = _build(RouterTransportConfig, self.transport)
         if isinstance(self.autoscale, dict):
             self.autoscale = _build(AutoscaleConfig, self.autoscale)
+        if isinstance(self.journal, dict):
+            self.journal = _build(JournalConfig, self.journal)
         if self.replicas < 1:
             raise DeepSpeedConfigError(
                 f"serving.router.replicas must be >= 1, got {self.replicas}")

@@ -42,9 +42,16 @@ _STAGE_ORDER = {
     "http_accepted": -1,
     "arrived": 0, "dispatched": 1, "requeued": 2, "admitted": 3,
     "prefix_hit": 4, "chunk": 5, "first_token": 6, "stream_started": 6.5,
+    "stream_resumed": 6.6,
     "quarantine": 7, "failover": 8, "shed": 8.25,
     "client_disconnected": 8.5, "terminal": 9, "stream_done": 10,
 }
+
+# uids at/past this base are fleet infrastructure (the rolling upgrade's
+# per-wave canary generates, inference/router.py), never user traffic —
+# tracers skip them so timelines and Perfetto exports stay user-only.
+# Disjoint by construction from gateway uid bands (gid << 32, gid < 2^17).
+RESERVED_UID_BASE = 1 << 62
 
 
 class RequestTracer:
@@ -61,6 +68,8 @@ class RequestTracer:
         self._seq = 0  # total events ever recorded (ring evicts, seq doesn't)
 
     def record(self, uid: int, event: str, t: float | None = None, **attrs) -> None:
+        if uid >= RESERVED_UID_BASE:
+            return  # infrastructure uids (upgrade canaries) are not traffic
         if t is None and self._clock is not None:
             t = self._clock()
         ev = {"uid": int(uid), "event": event, "t": float(t or 0.0)}

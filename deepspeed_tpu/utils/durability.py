@@ -1,0 +1,42 @@
+"""Durable small-file writes: ONE copy of the tmp + fsync + rename +
+directory-fsync sequence (the checkpoint saver's rename-durability
+discipline, docs/resilience.md "Atomic checksummed checkpoints") for the
+control plane's crash-safety records — the worker supervisor's engine
+spec and per-slot pidfiles, the request journal's compaction rewrite.
+
+A fix to the discipline itself (fsync-failure handling, platform quirks)
+lands here once instead of in every caller. ``checkpoint/saver.py`` keeps
+its own guarded writers on purpose: they weave the fault-injection write
+clock through every byte written, which these helpers must not.
+
+Stdlib-only (no jax): importable from launcher/ and inference/journal.py
+without a device runtime.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory holding ``path`` — rename durability lives in
+    the directory entries, not the file (the PR 4 round-3 lesson)."""
+    fd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_durable_bytes(path: str, data: bytes) -> None:
+    """Atomically install ``data`` at ``path``: tmp + flush + fsync +
+    rename + directory fsync. A crash at any instant reads either the old
+    content or the new — never a torn hybrid, never a renamed-but-lost
+    entry."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(path)

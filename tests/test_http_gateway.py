@@ -61,20 +61,29 @@ class _FakeRouter:
         self.reject_with = None
         self.brownout = False
         self._autoscaler = None
+        self._idem = {}
 
     # -- surface ---------------------------------------------------------
 
     def now(self):
         return time.perf_counter() - self._epoch
 
-    def submit(self, request):
+    def submit(self, request, idempotency_key=None):
         if self.reject_with is not None:
             raise self.reject_with
         self.submitted.append(request)
         self._owner[request.uid] = 0
         self._revealed[request.uid] = 0
         self.plan.setdefault(request.uid, [7, 8, 9])
+        if idempotency_key:
+            self._idem[idempotency_key] = request.uid
         return request.uid
+
+    def idempotency_lookup(self, key):
+        return self._idem.get(key)
+
+    def idempotency_map(self):
+        return dict(self._idem)
 
     def cancel(self, uid):
         if uid not in self._owner:
@@ -180,6 +189,8 @@ def _read_sse(resp, conn, until_done=True):
                     ev["event"] = line[7:].decode()
                 elif line.startswith(b"data: "):
                     ev["data"] = json.loads(line[6:])
+                elif line.startswith(b"id: "):
+                    ev["id"] = int(line[4:])
             if ev:
                 events.append(ev)
         if until_done and any(e.get("event") == "done" for e in events):
@@ -460,15 +471,36 @@ def test_gateway_stage_events_merge_in_timeline_order(request):
 # ----------------------------------------------- rolling upgrade (fakes)
 
 
+class _FakeResult:
+    """Just enough RequestResult surface for the canary gate (ok/status/
+    tokens) without pulling the serving dataclass into a host-only fake."""
+
+    def __init__(self, uid, status="ok"):
+        self.uid = uid
+        self.status = status
+        self.tokens = [1, 2]
+
+    @property
+    def ok(self):
+        return self.status == "ok"
+
+
 class _FakeEngine:
     """Host-only scheduler surface behind a REAL Router (the
-    test_autoscaler idiom, plus ``partial_tokens``)."""
+    test_autoscaler idiom, plus ``partial_tokens``). ``serves=True``
+    (default) makes ``step`` finish each queued request after one step —
+    enough to pass the rolling upgrade's per-wave canary generate;
+    ``serves=False`` models a newcomer that boots and steps clean but can
+    never actually serve (the idle-step-gate hole the canary closes)."""
 
-    def __init__(self, rid=0, compiled=False):
+    def __init__(self, rid=0, compiled=False, serves=True):
         self.replica_id = rid
         self.queued = []
         self.last_step_compiled = compiled
         self.fail_next_step = False
+        self.serves = serves
+        self.results = {}
+        self._aged = []
 
     def submit(self, req):
         self.queued.append(req)
@@ -484,10 +516,17 @@ class _FakeEngine:
         return None
 
     def cancel(self, uid):
-        return False
+        # faithful to the real engine: a cancel frees the queued request
+        n = len(self.queued) + len(self._aged)
+        self.queued = [r for r in self.queued if r.uid != uid]
+        self._aged = [r for r in self._aged if r.uid != uid]
+        if len(self.queued) + len(self._aged) == n:
+            return False
+        self.results[uid] = _FakeResult(uid, status="cancelled")
+        return True
 
     def result(self, uid):
-        return None
+        return self.results.get(uid)
 
     def partial_tokens(self, uid):
         return np.zeros((0,), np.int32)
@@ -496,7 +535,14 @@ class _FakeEngine:
         if self.fail_next_step:
             self.fail_next_step = False
             raise OSError("fake worker gone")
-        return []
+        if not self.serves:
+            return []
+        done = [r.uid for r in self._aged]
+        for r in self._aged:
+            self.results[r.uid] = _FakeResult(r.uid)
+        self._aged = list(self.queued)  # served on the NEXT step
+        self.queued = []
+        return done
 
     def live_requests(self):
         return list(self.queued)
@@ -674,6 +720,214 @@ def test_upgrade_gate_times_out_on_compiling_forever_newcomer():
     assert states[0] == "healthy"          # old generation serving
     assert states[1] in ("drained", "dead")  # newcomer cleanly out
     _await(lambda: sup.retired == [1])
+
+
+def test_upgrade_canary_closes_the_idle_step_gate():
+    """The hole the per-wave canary closes (PR 13's documented limit): a
+    newcomer that boots and steps clean but can never SERVE passed the
+    idle-step gate. With the canary (default on) it aborts — the old
+    generation keeps serving; with ``canary=False`` the same newcomer
+    sails through, which is exactly why the canary is the default."""
+
+    class _NoServeSupervisor(_FakeSupervisor):
+        def spawn(self, slot):
+            e = _FakeEngine(200 + slot, serves=False)
+            self.spawned.append((slot, e))
+            return e
+
+    router = Router(replica_engines=[_FakeEngine(0)],
+                    config={"router": {"health": {"timeout": 0}}})
+    sup = _NoServeSupervisor()
+    router.rolling_upgrade(supervisor=sup, slots={0: 0}, gate_timeout_s=2.0)
+    st = _drive(router, n=60)
+    assert st["state"] == "aborted" and "canary" in st["reason"]
+    assert router.replica_states()[0] == "healthy"  # old keeps serving
+    # the SAME cannot-serve newcomer passes the legacy idle-step-only gate
+    router2 = Router(replica_engines=[_FakeEngine(0)],
+                     config={"router": {"health": {"timeout": 0}}})
+    sup2 = _NoServeSupervisor()
+    router2.rolling_upgrade(supervisor=sup2, slots={0: 0},
+                            gate_timeout_s=2.0, canary=False)
+    assert _drive(router2)["state"] == "done"
+
+
+def test_upgrade_canary_uid_band_is_reserved_and_untraced():
+    """Canary generates live in the RESERVED uid band: never in the
+    Router's user results, never recorded by any RequestTracer — they are
+    infrastructure, not traffic."""
+    from deepspeed_tpu.telemetry.request_trace import (RESERVED_UID_BASE,
+                                                       RequestTracer)
+
+    router = Router(replica_engines=[_FakeEngine(0)],
+                    config={"router": {"health": {"timeout": 0}}})
+    sup = _FakeSupervisor()
+    router.rolling_upgrade(supervisor=sup, slots={0: 0})
+    st = _drive(router)
+    assert st["state"] == "done"
+    (_, newcomer), = sup.spawned
+    canary_uids = [u for u in newcomer.results if u >= RESERVED_UID_BASE]
+    assert canary_uids, "the wave never served a canary"
+    assert all(u < RESERVED_UID_BASE for u in router.results)
+    assert st["waves"][0].get("canary_status") == "ok"
+    # tracer band filter: a reserved uid is dropped at record time
+    tr = RequestTracer(16)
+    tr.record(RESERVED_UID_BASE + 1, "arrived")
+    tr.record(5, "arrived")
+    assert [e["uid"] for e in tr.events()] == [5]
+
+
+def test_upgrade_canary_survives_a_long_lived_fleet_clock():
+    """Deadlines are ABSOLUTE (arrival_time + deadline_s on the fleet
+    clock), so a canary submitted with arrival_time=0.0 would already be
+    expired on any fleet older than gate_timeout_s and every upgrade
+    would spuriously abort. The canary must arrive at NOW on the fleet
+    clock — this drives an upgrade on a fleet that has been up for ~10k
+    seconds and asserts the canary rode the live clock."""
+
+    class _RecordingSupervisor(_FakeSupervisor):
+        def spawn(self, slot):
+            e = _FakeEngine(300 + slot)
+            submitted = []
+            orig = e.submit
+
+            def submit(req):
+                submitted.append(req)
+                return orig(req)
+
+            e.submit = submit
+            e.submitted = submitted
+            self.spawned.append((slot, e))
+            return e
+
+    router = Router(replica_engines=[_FakeEngine(0)],
+                    config={"router": {"health": {"timeout": 0}}})
+    sup = _RecordingSupervisor()
+    router.rolling_upgrade(supervisor=sup, slots={0: 0}, gate_timeout_s=5.0)
+    st = _drive(router, t0=10_000.0)  # fleet clock ~10k s at upgrade time
+    assert st["state"] == "done"
+    (_, newcomer), = sup.spawned
+    (canary,) = newcomer.submitted
+    # arrived on the live fleet clock — deadline is gate_timeout_s from
+    # SUBMISSION, not an absolute instant 10k seconds in the past
+    assert canary.arrival_time >= 10_000.0
+    assert canary.arrival_time + canary.deadline_s > 10_000.0
+
+
+# ------------------------------------------- idempotency & stream resume
+
+
+def test_idempotency_key_retry_never_forks_a_uid(request):
+    router = _FakeRouter()
+    gw = _gw(request, router)
+    hdr = {"X-DSTPU-Idempotency-Key": "job-42"}
+    first = _post(gw, {"prompt": [1, 2, 3], "stream": False}, headers=hdr)
+    assert first["status"] == 200 and first["json"]["status"] == "ok"
+    retry = _post(gw, {"prompt": [1, 2, 3], "stream": False}, headers=hdr)
+    assert retry["json"]["uid"] == first["json"]["uid"]
+    assert retry["json"]["tokens"] == first["json"]["tokens"] == [7, 8, 9]
+    assert len(router.submitted) == 1, "a retried key forked a submit"
+    counters = router.telemetry.registry.snapshot()["counters"]
+    assert counters["gateway/idempotent_replays"] == 1
+
+
+def test_idempotency_retry_race_single_submit(request):
+    """Two concurrent POSTs with ONE key: the serve loop processes submits
+    serially, so exactly one reaches the Router — both clients stream the
+    same uid to the same terminal result."""
+    import threading as _threading
+
+    router = _FakeRouter(pace_s=0.02)
+    router.plan[1] = list(range(12))
+    gw = _gw(request, router)
+    hdr = {"X-DSTPU-Idempotency-Key": "raced"}
+    outs = {}
+
+    def post(tag):
+        out = _post(gw, {"prompt": [1, 2, 3]}, headers=hdr)
+        outs[tag] = {"uid": out["uid"],
+                     "events": _read_sse(out["resp"], out["conn"])}
+
+    ts = [_threading.Thread(target=post, args=(k,)) for k in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30.0)
+    assert len(router.submitted) == 1, "the race forked a submit"
+    uids = {outs[k]["uid"] for k in outs}
+    assert len(uids) == 1
+    for k in outs:
+        done = [e for e in outs[k]["events"] if e["event"] == "done"]
+        assert done and done[0]["data"]["tokens"] == list(range(12))
+
+
+def test_last_event_id_resumes_across_a_gateway_restart(request):
+    """The session-resume contract without a journal: gateway 1 serves a
+    keyed stream to completion and STOPS; gateway 2 over the same Router
+    seeds its idempotency map from the fleet and a reconnect with
+    ``Last-Event-ID`` replays exactly the suffix — one bitwise stream
+    across two gateway processes' worth of state."""
+    router = _FakeRouter()
+    gw1 = _gw(request, router)
+    out = _post(gw1, {"prompt": [1, 2, 3]},
+                headers={"X-DSTPU-Idempotency-Key": "ride-out"})
+    events = _read_sse(out["resp"], out["conn"])
+    toks = [e for e in events if e["event"] == "token"]
+    assert [e["id"] for e in toks] == [0, 1, 2]  # id: lines = resume cursor
+    gw1.trigger_shutdown()
+    gw1.stop()
+
+    gw2 = _gw(request, router)
+    out2 = _post(gw2, {"prompt": [1, 2, 3]},
+                 headers={"X-DSTPU-Idempotency-Key": "ride-out",
+                          "Last-Event-ID": "0"})
+    events2 = _read_sse(out2["resp"], out2["conn"])
+    toks2 = [e for e in events2 if e["event"] == "token"]
+    assert [e["id"] for e in toks2] == [1, 2]  # resumed PAST the cursor
+    assert [e["data"]["token"] for e in toks2] == [8, 9]
+    done2 = [e for e in events2 if e["event"] == "done"][0]["data"]
+    assert done2["tokens"] == [7, 8, 9]
+    assert len(router.submitted) == 1
+    counters = router.telemetry.registry.snapshot()["counters"]
+    assert counters["gateway/resumed_streams"] == 1
+
+
+def test_last_event_id_resume_parity_real_engine(request, tiny_serving_engine):
+    """Satellite proof on REAL decode programs (session shapes, watchdog
+    RAISE): a keyed stream completed through gateway 1 resumes through
+    gateway 2 at ``Last-Event-ID`` with the exact greedy suffix — the
+    concatenated client view is bit-identical to ``generate``."""
+    engine = tiny_serving_engine
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 97, size=5).astype(np.int32)
+    ref = [int(t) for t in engine.generate(prompt[None], max_new_tokens=8)[0]]
+    router = Router(engine, config={
+        "n_slots": 2, "max_seq_len": 128, "watchdog_mode": "raise",
+        "router": {"replicas": 1, "health": {"timeout": 60.0}}})
+    gw1 = _gw(request, router, cfg={"stream_poll_s": 0.01})
+    hdr = {"X-DSTPU-Idempotency-Key": "parity"}
+    out = _post(gw1, {"prompt": [int(t) for t in prompt],
+                      "max_new_tokens": 8}, headers=hdr)
+    events = _read_sse(out["resp"], out["conn"])
+    got = [e["data"]["token"] for e in events if e["event"] == "token"]
+    assert got == ref
+    gw1.trigger_shutdown()
+    gw1.stop()
+
+    gw2 = _gw(request, router, cfg={"stream_poll_s": 0.01})
+    out2 = _post(gw2, {"prompt": [int(t) for t in prompt],
+                       "max_new_tokens": 8},
+                 headers={**hdr, "Last-Event-ID": "2"})
+    events2 = _read_sse(out2["resp"], out2["conn"])
+    toks2 = [e for e in events2 if e["event"] == "token"]
+    assert [e["id"] for e in toks2] == list(range(3, 8))
+    assert got[:3] + [e["data"]["token"] for e in toks2] == ref
+    done2 = [e for e in events2 if e["event"] == "done"][0]["data"]
+    assert done2["status"] == "ok" and done2["tokens"] == ref
+    # one submit ever, one decode program ever (raise-mode held)
+    assert router._replicas[0].engine.compile_counts()["decode"] == 1
+    counters = router.telemetry.registry.snapshot()["counters"]
+    assert counters["gateway/resumed_streams"] == 1
+    assert counters["gateway/idempotent_replays"] == 1
 
 
 def test_supervisor_set_spec_is_durable(tmp_path):

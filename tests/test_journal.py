@@ -1,0 +1,198 @@
+"""Request journal (inference/journal.py): the replay matrix.
+
+Host-only — no compiled programs, no device work: the journal is stdlib +
+numpy by design, and the whole module costs well under a second of tier-1
+budget. The contracts under test are the ones recovery rests on:
+
+  * replay is IDEMPOTENT: replaying the same file twice yields equal
+    states (and the state equals the writer's in-memory mirror);
+  * a TORN TAIL (crash mid-append) is tolerated, counted, and truncated
+    by the next open's compaction — at every possible cut point;
+  * MID-FILE corruption (bit flip, bad magic with data after it) is a
+    typed ``JournalCorruptError``, never a silent partial replay;
+  * double-terminal records replay last-writer-wins, cancel-without-
+    terminal replays as a ``cancelled`` terminal;
+  * rotation/compaction keeps the file bounded, preserves live requests
+    and the idempotency keys of retained terminals, and ages old
+    terminals (and their keys) out of the keep window.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.journal import (JournalState, RequestJournal,
+                                             replay)
+from deepspeed_tpu.inference.serving import Request, RequestResult
+from deepspeed_tpu.resilience import JournalCorruptError
+
+
+def _req(uid, n=5):
+    rng = np.random.default_rng(uid)
+    return Request(uid=uid, prompt=rng.integers(0, 97, size=n).astype(np.int32),
+                   max_new_tokens=4)
+
+
+def _res(uid, status="ok", n_tok=4):
+    return RequestResult(
+        uid=uid, tokens=np.arange(n_tok, dtype=np.int32) + uid,
+        prompt_len=5, arrival_time=0.0, finish_time=1.0, status=status)
+
+
+@pytest.fixture
+def jpath(tmp_path):
+    return str(tmp_path / "router.journal")
+
+
+def test_replay_roundtrip_and_idempotence(jpath):
+    j = RequestJournal(jpath)
+    j.record_submit(_req(1), key="k1")
+    j.record_submit(_req(2))
+    j.record_terminal(1, _res(1))
+    j.record_cancel(2)
+    j.close()
+    s1, s2 = replay(jpath), replay(jpath)
+    assert s1 == s2  # the idempotence contract, asserted on whole states
+    # file and in-memory mirror agree on SEMANTIC state (records /
+    # truncated_tail_bytes are replay bookkeeping the writer doesn't track)
+    for field in ("requests", "req_keys", "terminals", "idem", "epoch_wall"):
+        assert getattr(s1, field) == getattr(j.state, field), field
+    assert set(s1.terminals) == {1, 2}
+    assert s1.terminals[1]["status"] == "ok"
+    assert s1.terminals[2]["status"] == "cancelled"  # cancel, no terminal
+    assert s1.requests == {}  # nothing left live
+    assert s1.idem == {"k1": 1}
+
+
+def test_torn_tail_tolerated_at_every_cut_point(jpath):
+    j = RequestJournal(jpath)
+    j.record_submit(_req(1), key="k1")
+    j.record_terminal(1, _res(1))
+    j.record_submit(_req(2))
+    j.close()
+    intact = replay(jpath)
+    blob = open(jpath, "rb").read()
+    # find the last record's start: replay byte prefixes and the state
+    # must equal the longest intact prefix at EVERY truncation point
+    for cut in range(len(blob) - 1, len(blob) - 40, -1):
+        open(jpath, "wb").write(blob[:cut])
+        st = replay(jpath)  # never raises: a torn tail is expected
+        assert st.truncated_tail_bytes > 0 or st.records == intact.records
+    # a torn MID-HEADER tail (shorter than the 12-byte header) too
+    open(jpath, "wb").write(blob + b"DSJR\x00")
+    st = replay(jpath)
+    assert st.truncated_tail_bytes == 5
+    assert st.requests == intact.requests
+    # reopening compacts: the rewritten file replays with no tail at all
+    j2 = RequestJournal(jpath)
+    j2.close()
+    assert replay(jpath).truncated_tail_bytes == 0
+    assert replay(jpath).requests == intact.requests
+
+
+def test_mid_file_bit_flip_is_typed_corruption(jpath):
+    j = RequestJournal(jpath)
+    j.record_submit(_req(1))
+    j.record_submit(_req(2))
+    j.record_terminal(1, _res(1))
+    j.close()
+    blob = bytearray(open(jpath, "rb").read())
+    blob[len(blob) // 2] ^= 0x40  # flip one bit well inside the file
+    open(jpath, "wb").write(bytes(blob))
+    with pytest.raises(JournalCorruptError) as ei:
+        replay(jpath)
+    assert ei.value.path == jpath and ei.value.offset >= 0
+
+
+def test_bad_magic_with_data_after_is_corruption_not_tail(jpath):
+    j = RequestJournal(jpath)
+    j.record_submit(_req(1))
+    j.close()
+    blob = open(jpath, "rb").read()
+    # overwrite the FIRST record's magic but keep the rest of the file:
+    # a desynced stream with valid-looking data after it is corruption
+    open(jpath, "wb").write(b"XXXX" + blob[4:])
+    with pytest.raises(JournalCorruptError):
+        replay(jpath)
+
+
+def test_double_terminal_replays_last_writer_wins(jpath):
+    j = RequestJournal(jpath)
+    j.record_submit(_req(1))
+    j.record_terminal(1, _res(1, status="ok"))
+    # a second terminal for the same uid (e.g. a recovery-harvested result
+    # re-recorded after a crash window): replay must not error, the last
+    # record wins
+    j.state.requests[1] = {"uid": 1}  # re-arm so record_terminal accepts
+    j.record_terminal(1, _res(1, status="cancelled", n_tok=2))
+    j.close()
+    s = replay(jpath)
+    assert s.records >= 3
+    assert s.terminals[1]["status"] == "cancelled"
+    assert replay(jpath) == s
+
+
+def test_rotation_bounds_the_file_and_keeps_live_state(jpath):
+    j = RequestJournal(jpath, rotate_max_records=8, keep_terminals=3)
+    j.record_submit(_req(100), key="live-key")  # stays live throughout
+    sizes = []
+    for uid in range(1, 30):
+        j.record_submit(_req(uid), key=f"k{uid}")
+        j.record_terminal(uid, _res(uid))
+        sizes.append(os.path.getsize(jpath))
+    assert j.state.requests.keys() == {100}
+    assert len(j.state.terminals) <= 8 + 3  # bounded between compactions
+    # the file itself stays bounded: compactions shrank it repeatedly
+    assert min(sizes[-10:]) < max(sizes[:10]) * 3
+    j.compact()
+    st = replay(jpath)
+    assert set(st.requests) == {100}
+    assert len(st.terminals) == 3  # the keep window
+    assert st.idem.get("live-key") == 100  # live submit keeps its key
+    # retained terminals keep their keys; aged-out ones lose them
+    for uid in st.terminals:
+        assert st.idem.get(f"k{uid}") == uid
+    assert "k1" not in st.idem
+    j.close()
+
+
+def test_fresh_journal_writes_epoch_and_recovered_flag(jpath):
+    j = RequestJournal(jpath)
+    assert not j.recovered  # nothing to recover from a fresh file
+    assert j.state.epoch_wall is not None
+    j.record_submit(_req(1))
+    j.close()
+    j2 = RequestJournal(jpath)
+    assert j2.recovered  # a live request makes the restart a recovery
+    # the epoch anchor survives reopen (the fleet clock continues)
+    assert j2.state.epoch_wall == pytest.approx(j.state.epoch_wall)
+    j2.close()
+
+
+def test_terminal_for_unknown_uid_is_skipped(jpath):
+    j = RequestJournal(jpath)
+    assert j.record_terminal(999, _res(999)) is False  # never accepted
+    j.record_submit(_req(1))
+    assert j.record_terminal(1, _res(1)) is True
+    j.close()
+    assert set(replay(jpath).terminals) == {1}
+
+
+def test_state_apply_matches_file_replay_record_for_record(jpath):
+    """The writer's in-memory mirror goes through the SAME transition
+    function replay uses — drift between them is structurally impossible,
+    but the contract deserves a direct witness."""
+    j = RequestJournal(jpath)
+    mirror = JournalState()
+    mirror.epoch_wall = j.state.epoch_wall
+    for uid in (1, 2, 3):
+        j.record_submit(_req(uid), key=f"k{uid}")
+        mirror.apply({"t": "submit",
+                      "req": j.state.requests[uid], "key": f"k{uid}"})
+    j.record_cancel(2)
+    mirror.apply({"t": "cancel", "uid": 2})
+    assert j.state.requests == mirror.requests
+    assert j.state.terminals == mirror.terminals
+    assert j.state.idem == mirror.idem
+    j.close()

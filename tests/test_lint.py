@@ -47,6 +47,7 @@ def findings_for(res, rule):
 def test_registry_has_the_shipped_rules():
     expected = {"wall-clock-verdict", "broad-except", "blocking-under-lock",
                 "unguarded-donation", "rename-durability",
+                "append-durability",
                 "socket-discipline", "unlogged-collective",
                 "config-doc-drift", "metric-doc-drift",
                 "pragma", "parse-error"}
@@ -456,6 +457,70 @@ def test_rename_with_fsync_or_durable_helper_passes(tmp_path):
     """})
     res = run_lint(pkg, rule_ids=["rename-durability"])
     assert not findings_for(res, "rename-durability")
+
+
+# ---------------------------------------------------------------------------
+# append-durability
+
+
+def test_append_without_fsync_in_journal_module_flags(tmp_path):
+    pkg = make_tree(tmp_path, {"inference/journal.py": """\
+        def append(path, rec):
+            with open(path, "ab") as f:
+                f.write(rec)
+    """})
+    res = run_lint(pkg, rule_ids=["append-durability"])
+    (f,) = findings_for(res, "append-durability")
+    assert "flush/fsync" in f.message and f.line == 2
+
+
+def test_append_to_wal_shaped_path_flags_outside_journal_module(tmp_path):
+    # the PATH EXPRESSION names the WAL even though the module doesn't
+    pkg = make_tree(tmp_path, {"serving/state.py": """\
+        def log(wal_path, rec):
+            f = open(wal_path, mode="a")
+            f.write(rec)
+    """})
+    res = run_lint(pkg, rule_ids=["append-durability"])
+    (f,) = findings_for(res, "append-durability")
+    assert "journal/WAL-shaped" in f.message
+
+
+def test_append_with_flush_and_fsync_passes(tmp_path):
+    pkg = make_tree(tmp_path, {"inference/journal.py": """\
+        import os
+        def append(path, rec):
+            with open(path, "ab") as f:
+                f.write(rec)
+                f.flush()
+                os.fsync(f.fileno())
+    """})
+    res = run_lint(pkg, rule_ids=["append-durability"])
+    assert not findings_for(res, "append-durability")
+
+
+def test_ordinary_append_logs_are_exempt(tmp_path):
+    # advisory appends (JSONL sinks, CSV monitors) are not journal-shaped:
+    # neither module name nor path expression mentions journal/wal
+    pkg = make_tree(tmp_path, {"telemetry/exporters.py": """\
+        def sink(path, line):
+            with open(path, "a") as f:
+                f.write(line)
+    """})
+    res = run_lint(pkg, rule_ids=["append-durability"])
+    assert not findings_for(res, "append-durability")
+
+
+def test_append_durability_pragma_with_rationale_suppresses(tmp_path):
+    pkg = make_tree(tmp_path, {"inference/journal.py": """\
+        def debug_tap(path, rec):
+            # dstpu: allow[append-durability] -- debug tap, replay never reads it
+            with open(path, "ab") as f:
+                f.write(rec)
+    """})
+    res = run_lint(pkg, rule_ids=["append-durability"])
+    assert not findings_for(res, "append-durability")
+    assert res.suppressed
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,218 @@
+"""Crash-safe control plane (inference/journal.py + Router cold-start
+recovery): the brain dies, the fleet doesn't.
+
+The contract under test (docs/serving.md "Crash-safe control plane"): a
+Router with a request journal can be ABANDONED mid-traffic (the in-process
+spelling of the ``bench.py --router-chaos`` SIGKILL — the deterministic
+``router_crash`` fault site provides the typed raise) and a NEW Router
+built over the same replicas + journal recovers with zero accepted-request
+loss: journaled terminals replay, in-flight requests still held by
+surviving replicas are ADOPTED (never re-dispatched — nothing runs twice),
+and requests whose replica died in the gap fall through to the existing
+exactly-once failover path. Completed greedy outputs stay bit-identical
+to the unfaulted run throughout, under watchdog RAISE.
+
+Speed: every test reuses the session-scoped ``tiny_serving_engine``
+fixture and the session parity shapes (prompts [5, 11, 23], max_new 8,
+n_slots 2) — the journal and recovery machinery are pure host code, so
+this module adds NO new XLA programs.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import Request, Router
+from deepspeed_tpu.inference.serving import ServingEngine
+from deepspeed_tpu.resilience import ControlPlaneCrash
+from deepspeed_tpu.runtime.config import (DeepSpeedConfigError, JournalConfig,
+                                          RouterConfig)
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_serving_engine):
+    return tiny_serving_engine
+
+
+def _prompts(sizes=(5, 11, 23), seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 97, size=s).astype(np.int32) for s in sizes]
+
+
+def _replica(engine, **extra):
+    return ServingEngine(engine, config={
+        "n_slots": 2, "max_seq_len": 128, "watchdog_mode": "raise", **extra})
+
+
+def _journal_router(engines, jpath, **router_extra):
+    return Router(replica_engines=engines, config={"router": {
+        "health": {"timeout": 60.0},
+        "journal": {"enabled": True, "path": str(jpath)},
+        **router_extra}})
+
+
+def test_journal_config_schema():
+    jc = RouterConfig(journal={"enabled": True, "path": "/tmp/j"}).journal
+    assert isinstance(jc, JournalConfig) and jc.fsync
+    with pytest.raises(DeepSpeedConfigError):
+        JournalConfig(enabled=True)  # enabled requires a path
+    with pytest.raises(DeepSpeedConfigError):
+        JournalConfig(rotate_max_records=1)
+    with pytest.raises(DeepSpeedConfigError):
+        JournalConfig(keep_terminals=-1)
+
+
+def test_router_crash_fault_site_is_typed(engine, tmp_path):
+    router = _journal_router([_replica(engine)], tmp_path / "j")
+    router._inj = __import__(
+        "deepspeed_tpu.resilience", fromlist=["FaultInjector"]
+    ).FaultInjector({"enabled": True, "router_crash_at": [2]})
+    router.step(now=0.0)  # step 1: fine
+    with pytest.raises(ControlPlaneCrash):
+        router.step(now=0.0)  # step 2: the control plane "dies"
+    # fires exactly once (list-mode): a recovered successor's step 2 is
+    # its own clock anyway, but even THIS router would not re-crash
+    router.step(now=0.0)
+
+
+def test_crash_recovery_adopts_inflight_and_replays_terminals(
+        engine, tmp_path):
+    """The headline recovery path: one request finished (journaled
+    terminal), two mid-flight on surviving replicas (adopted). The
+    restarted Router loses nothing, re-runs nothing, and completed greedy
+    streams are bit-identical to the solo generate."""
+    prompts = _prompts()
+    # request 0 is SHORT (max_new 4) so it reaches its journaled terminal
+    # while 1 and 2 are still mid-decode — the crash window under test
+    max_new = [4, 8, 8]
+    refs = [engine.generate(p[None], max_new_tokens=n)[0]
+            for p, n in zip(prompts, max_new)]
+    e1, e2 = _replica(engine), _replica(engine)
+    jpath = tmp_path / "j"
+
+    a = _journal_router([e1, e2], jpath)
+    for i, p in enumerate(prompts):
+        a.submit(Request(uid=i, prompt=p, max_new_tokens=max_new[i]),
+                 idempotency_key=f"key-{i}" if i == 0 else None)
+    # run until the FIRST terminal lands in the journal, then "crash"
+    for _ in range(200):
+        if a.step(now=0.0):
+            break
+    else:
+        raise AssertionError("no request ever finished")
+    finished = set(a.results)
+    assert finished and len(finished) < 3
+    a._journal.close()  # the OS would do this for a real SIGKILL
+    del a
+
+    b = _journal_router([e1, e2], jpath)
+    counters = b.telemetry.registry.snapshot()["counters"]
+    assert counters["router/recovery/recoveries"] == 1
+    assert counters["router/recovery/replayed_terminals"] == len(finished)
+    assert counters["router/recovery/adopted_requests"] == 3 - len(finished)
+    assert counters.get("router/recovery/redispatched", 0) == 0
+    # the finished request's result replayed from the journal, bitwise
+    for u in finished:
+        np.testing.assert_array_equal(b.results[u].tokens, refs[u])
+    # the idempotency mapping survived the restart
+    assert b.idempotency_lookup("key-0") == 0
+    # adopted requests finish where they were, with parity — no re-runs
+    res = b.drain()
+    for i in range(3):
+        assert res[i].ok, (i, res[i].status)
+        np.testing.assert_array_equal(res[i].tokens, refs[i])
+    # watchdog RAISE held: ONE decode program per replica, before & after
+    assert e1.compile_counts()["decode"] == 1
+    assert e2.compile_counts()["decode"] == 1
+
+
+def test_recovery_reconcile_vs_dead_worker_falls_through_to_failover(
+        engine, tmp_path):
+    """A worker that died BETWEEN crash and restart cannot be reconciled:
+    its journaled-accepted request is unaccounted and must re-dispatch
+    through the exactly-once failover path onto the new fleet — completed
+    with parity, counted as a failover, terminal either way."""
+    prompts = _prompts()
+    ref = engine.generate(prompts[0][None], max_new_tokens=8)[0]
+    jpath = tmp_path / "j"
+    e_dead = _replica(engine)
+    a = _journal_router([e_dead], jpath)
+    a.submit(Request(uid=0, prompt=prompts[0], max_new_tokens=8))
+    a.step(now=0.0)  # admitted on e_dead, mid-flight
+    assert a.owner_of(0) == 0
+    a._journal.close()
+    del a
+
+    # the restarted fleet does NOT contain e_dead (its process is gone)
+    e_new = _replica(engine)
+    b = _journal_router([e_new], jpath)
+    counters = b.telemetry.registry.snapshot()["counters"]
+    assert counters["router/recovery/redispatched"] == 1
+    assert counters.get("router/recovery/adopted_requests", 0) == 0
+    assert counters["router/failovers"] == 1
+    res = b.drain()
+    assert res[0].ok
+    np.testing.assert_array_equal(res[0].tokens, ref)  # replay from scratch
+    assert e_new.compile_counts()["decode"] == 1
+
+
+def test_recovery_with_no_surviving_replica_fails_typed_not_silent(
+        engine, tmp_path):
+    """Recovery with NOTHING left to serve on: the journaled request gets
+    a typed ``failed_replica`` terminal (the exactly-once budget's no-
+    target verdict) — never a silent drop, never a hang."""
+    jpath = tmp_path / "j"
+    e1 = _replica(engine)
+    a = _journal_router([e1], jpath)
+    a.submit(Request(uid=0, prompt=_prompts()[0], max_new_tokens=8))
+    a._journal.close()
+    del a
+    e2 = _replica(engine)
+    b = _journal_router([e2], jpath)
+    b.mark_dead(0)  # the only replica dies before recovery can dispatch…
+    # …but recovery ran at construction: the uid was re-dispatched onto
+    # e2 then failed over by mark_dead — either way it MUST be terminal
+    uids = b.step(now=0.0)
+    assert 0 in set(uids) | set(b.results)
+    assert b.result(0) is not None
+
+
+def test_journal_disabled_pays_zero_fsyncs_on_the_hot_path(
+        engine, tmp_path, monkeypatch):
+    """The acceptance bullet, literally: a journal-disabled fleet performs
+    ZERO fsync calls across submit/step/terminal."""
+    import os as os_mod
+
+    calls = {"n": 0}
+    real = os_mod.fsync
+
+    def counting_fsync(fd):
+        calls["n"] += 1
+        return real(fd)
+
+    e1 = _replica(engine)
+    router = Router(replica_engines=[e1],
+                    config={"router": {"health": {"timeout": 60.0}}})
+    monkeypatch.setattr(os_mod, "fsync", counting_fsync)
+    router.submit(Request(uid=0, prompt=_prompts()[0], max_new_tokens=8))
+    router.drain()
+    assert router.results[0].ok
+    assert calls["n"] == 0, "journal-disabled fleet fsync'd on the hot path"
+
+
+def test_epoch_continues_across_restart(engine, tmp_path):
+    """The fleet clock survives the brain: a recovered Router's epoch is
+    anchored so pre-crash arrival times stay in the PAST (a fresh epoch
+    would push queued arrivals into the apparent future and stall their
+    admission for the dead process's whole lifetime)."""
+    jpath = tmp_path / "j"
+    e1 = _replica(engine)
+    a = _journal_router([e1], jpath)
+    a.submit(Request(uid=0, prompt=_prompts()[0], max_new_tokens=8,
+                     arrival_time=a.now()))
+    arrival = a._requests[0].arrival_time
+    a._journal.close()
+    del a
+    b = _journal_router([e1], jpath)
+    assert b.now() >= arrival  # the clock continued, not restarted at 0
+    res = b.drain()
+    assert res[0].ok
