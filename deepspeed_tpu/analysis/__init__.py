@@ -10,8 +10,9 @@ machines without jax. Import surface:
     assert result.clean, result.findings
 """
 
-from . import checkers, cli, drift  # noqa: F401  (rules register on import)
+from . import audit, checkers, cli, drift  # noqa: F401  (rules register)
+from .audit import run_audit  # noqa: F401
 from .core import RULES, Finding, LintResult, run_lint  # noqa: F401
 
-__all__ = ["RULES", "Finding", "LintResult", "run_lint",
-           "checkers", "drift", "cli"]
+__all__ = ["RULES", "Finding", "LintResult", "run_lint", "run_audit",
+           "audit", "checkers", "drift", "cli"]

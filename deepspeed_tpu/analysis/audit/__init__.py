@@ -1,0 +1,23 @@
+"""deepspeed_tpu.analysis.audit — dstpu-audit, the interprocedural tier
+above dstpu-lint (docs/analysis.md, "Interprocedural audit").
+
+Three whole-module passes over a per-file program model (call graph,
+thread roles, lock sets, attribute accesses — ``model.FileModel``):
+thread races (``races``), lock-order cycles + condition-wait discipline
+(``locks``), and XLA recompile hazards at the jit boundary
+(``recompile``). Rules register in the SAME registry as dstpu-lint
+(``core.RULES``, scope ``audit``) so one pragma grammar and one finding
+schema cover both tools; ``bin/dstpu_audit`` loads this package by file
+path and runs without jax, exactly like ``bin/dstpu_lint``.
+
+    from deepspeed_tpu.analysis.audit import run_audit
+    result = run_audit("deepspeed_tpu")
+    assert result.clean, result.findings
+"""
+
+from . import cli, locks, races, recompile  # noqa: F401  (rules register)
+from .model import FileModel  # noqa: F401
+from .runner import audit_rules, run_audit  # noqa: F401
+
+__all__ = ["run_audit", "audit_rules", "FileModel",
+           "races", "locks", "recompile"]

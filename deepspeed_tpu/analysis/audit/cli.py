@@ -1,0 +1,68 @@
+"""dstpu-audit CLI — the house exit-code contract, shared with dstpu-lint:
+
+  0  clean (no findings, or none outside the baseline)
+  1  findings
+  2  usage error (bad path, unknown rule, unreadable baseline)
+
+Usage:
+
+  bin/dstpu_audit [PATH ...] [--rule ID] [--format text|json]
+                  [--baseline FILE] [--write-baseline FILE] [--list-rules]
+
+PATH defaults to the deepspeed_tpu package this file ships in. ``--format
+json`` emits the SAME finding schema as ``bin/dstpu_lint --format json``
+(``core.result_to_json``), so tooling consumes both with one parser. The
+final tree keeps an EMPTY baseline — every finding is fixed or pragma'd
+(docs/analysis.md, "Interprocedural audit").
+
+The driver (argparse surface, path checks, baseline ratchet, text/json
+printing) is ``core.cli_main``, shared verbatim with ``analysis/cli.py``
+— this module contributes only the audit-specific catalog, rule-id
+validation, and runner.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .. import core
+from .runner import audit_rules, run_audit
+
+
+def _default_target() -> str:
+    # cli.py lives at <pkg>/analysis/audit/cli.py -> audit <pkg>
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    available = audit_rules()
+
+    def _print_rules() -> None:
+        width = max(len(r) for r in available)
+        for rid in sorted(available):
+            print(f"{rid:<{width}}  {available[rid].doc}")
+
+    def _validate_rules(rule_ids: list[str]) -> Optional[str]:
+        # a LINT rule id is a usage error here: the tools gate different
+        # law books (tests pin exit 2 on --rule broad-except)
+        unknown = [r for r in rule_ids if r not in available]
+        if not unknown:
+            return None
+        return (f"unknown rule id(s): {', '.join(unknown)} "
+                f"(see --list-rules)")
+
+    return core.cli_main(
+        argv, tool="dstpu-audit",
+        description="deepspeed_tpu interprocedural thread-race / "
+                    "lock-order / recompile-hazard auditor "
+                    "(docs/analysis.md)",
+        default_target=_default_target(), runner=run_audit,
+        print_rules=_print_rules, validate_rules=_validate_rules)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""lock-order + wait-predicate: deadlock shape and lost-wakeup shape.
+
+``lock-order`` builds the acquired-while-holding graph — an edge A → B
+for every site that acquires B (lexically, or one the callee reaches —
+the model's may-held union) while A is held — and flags every cycle. Two
+threads walking a cycle's edges in opposite orders is the textbook
+deadlock, and the repo's lock census (router fleet lock, gateway stream
+lock, per-stream conditions, telemetry registry locks) is exactly big
+enough now that the pairwise argument no longer fits in a reviewer's
+head. The companion per-line rule ``blocking-under-lock`` catches stalls;
+this catches the shape that never unblocks at all.
+
+``wait-predicate`` flags ``<cond>.wait()`` calls with no enclosing loop
+in the same function: a condition variable woken spuriously (or by a
+broadcast for a different predicate) returns from ``wait`` with the
+predicate still false — the stdlib contract is wait-in-a-loop, and every
+legitimate site in the tree (the gateway's stream feeds) already follows
+it.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, rule
+from .model import FileModel
+
+
+@rule("lock-order",
+      "cycle in the acquired-while-holding graph (with-lock scopes plus "
+      "locks reached through called functions) — two threads taking the "
+      "cycle's locks in opposite orders deadlock; impose one global "
+      "order", scope="audit")
+def check_lock_order(fm: FileModel) -> list[Finding]:
+    # edge (A, B) -> the first acquisition site that created it
+    edges: dict[tuple, tuple] = {}
+    for acq in fm.lock_acqs:
+        held = acq.lex_held | acq.func.may_held
+        for h in held:
+            if h != acq.lock:
+                edges.setdefault((h, acq.lock), (acq.line, acq.func.key))
+    graph: dict[str, list] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+    out = []
+    seen_cycles: set = set()
+    for start in sorted(graph):
+        path: list[str] = []
+        on_path: set = set()
+
+        def dfs(node: str) -> None:
+            if node in on_path:
+                cyc = path[path.index(node):] + [node]
+                ident = frozenset(cyc)
+                if ident in seen_cycles:
+                    return
+                seen_cycles.add(ident)
+                line, fkey = edges[(cyc[0], cyc[1])]
+                hops = " -> ".join(cyc)
+                sites = "; ".join(
+                    f"{a}->{b} at line {edges[(a, b)][0]} "
+                    f"({edges[(a, b)][1]})"
+                    for a, b in zip(cyc, cyc[1:]))
+                out.append(Finding(
+                    "lock-order", fm.pf.rel, line,
+                    f"lock-order cycle {hops} ({sites}) — threads taking "
+                    f"these locks in opposite orders deadlock; pick one "
+                    f"global acquisition order"))
+                return
+            path.append(node)
+            on_path.add(node)
+            for nxt in sorted(graph.get(node, ())):
+                dfs(nxt)
+            path.pop()
+            on_path.discard(node)
+
+        dfs(start)
+    return out
+
+
+@rule("wait-predicate",
+      "<cond>.wait() with no enclosing loop in the function — a spurious "
+      "or stale wakeup returns with the predicate still false; re-check "
+      "in a while loop (the stdlib Condition contract)", scope="audit")
+def check_wait_predicate(fm: FileModel) -> list[Finding]:
+    out = []
+    for w in fm.waits:
+        if w.in_loop:
+            continue
+        out.append(Finding(
+            "wait-predicate", fm.pf.rel, w.line,
+            f"{w.receiver}.wait() outside any loop in {w.func.key}() — "
+            f"wrap it in `while not <predicate>:` so spurious wakeups "
+            f"re-check instead of proceeding on a false predicate"))
+    return out

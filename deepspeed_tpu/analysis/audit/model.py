@@ -1,0 +1,504 @@
+"""Per-module program model for dstpu-audit's interprocedural passes.
+
+dstpu-lint's checkers are single AST passes over single constructs; the
+three audit passes (races, lock order, recompile hazards) need facts that
+only exist ACROSS functions of a module: who calls whom, which thread a
+function runs on, which locks are held when a line executes, which
+instance attributes a class mutates where. ``FileModel`` computes those
+facts once per file, with the same design constraints as the rest of
+``analysis/``: stdlib ``ast`` only, no imports of the analysed code, no
+type inference beyond what the source spells out.
+
+What the model resolves (and, deliberately, what it does not):
+
+  * **call graph** — ``f()`` to a module function, ``self.m()`` to a
+    method of the enclosing class, ``x.m()`` where ``x`` is a parameter
+    annotated with a class of this module or a local assigned from a
+    class constructor (``stream = _Stream(uid)``). Closures see their
+    enclosing function's environment — the ``_make_handler(gw:
+    HttpGateway)`` idiom resolves. Cross-module calls are out of scope by
+    design: the model is module-level, matching how the control-plane
+    thread seams actually live (one file owns one loop).
+  * **thread roles** — seeded at creation sites: every
+    ``threading.Thread(target=f)`` gives ``f`` a fresh ``thread:<f>``
+    role; methods of ``http.server``/``socketserver`` handler classes run
+    as ``handler``; public functions and call-graph roots run as
+    ``main``. Roles propagate along call edges AND callback references (a
+    function passed as an ``on_tick=``-style argument runs in its
+    consumer's thread).
+  * **lock sets** — ``with <lockish>:`` scopes (a context expression whose
+    terminal name contains ``lock``/``mutex``/``cond`` — a
+    ``threading.Condition`` acquires its lock) tracked lexically, plus an
+    interprocedural *entry-held* set per function: the INTERSECTION over
+    all call sites of locks the caller provably held (what the race pass
+    may rely on), and a *may-held* UNION (what the deadlock pass must
+    assume).
+  * **attribute events** — reads/writes of ``self.x`` (and of typed
+    locals/params), including writes-by-proxy: subscript stores
+    (``self.d[k] = v``), aug-assigns, deletes, and calls of known mutator
+    methods (``append``/``pop``/``update``/...). Attributes constructed in
+    ``__init__`` from thread-safe stdlib types (``queue.Queue``,
+    ``threading.Event``, locks, ``deque``) are recorded with that type so
+    the race pass can exempt them.
+
+Unresolvable receivers produce NO edges/events — the passes report only
+where the source gave the model something to stand on, which is what
+keeps the finding list reviewable (pragmas carry the rest).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core import PyFile
+
+# context-manager expressions whose terminal name contains one of these
+# are treated as lock acquisitions (Condition.__enter__ acquires its lock)
+LOCK_MARKERS = ("lock", "mutex", "cond")
+
+# attribute types (recorded from __init__ constructor calls) whose own
+# operations are thread-safe by contract — mutating them is not a race
+SAFE_ATTR_TYPES = frozenset({
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "Event",
+    "Condition", "Semaphore", "BoundedSemaphore", "Lock", "RLock",
+    "Barrier", "deque",
+})
+
+# method names that mutate their receiver (dict/list/set/deque surface);
+# `self.x.append(v)` counts as a write of attribute `x`
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "update", "setdefault", "pop", "popleft", "popitem", "remove",
+    "discard", "clear",
+})
+
+_HANDLER_BASES = ("BaseHTTPRequestHandler", "StreamRequestHandler",
+                  "DatagramRequestHandler", "BaseRequestHandler")
+
+_CTOR_NAMES = ("__init__", "__new__", "__post_init__")
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def class_spans(tree: ast.AST) -> list[tuple]:
+    """``(start_line, end_line, name)`` for every ClassDef — the shared
+    index behind "which class does line N live in" (used by the audit's
+    recompile pass and dstpu-lint's blocking-under-lock call resolver)."""
+    return [(n.lineno, getattr(n, "end_lineno", n.lineno), n.name)
+            for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+
+
+def owning_class(spans: list[tuple], lineno: int):
+    """Innermost class span containing ``lineno`` (None outside any)."""
+    best = None
+    for start, end, name in spans:
+        if start <= lineno <= end and (best is None or start > best[0]):
+            best = (start, name)
+    return best[1] if best is not None else None
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    name = _terminal(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = _terminal(expr.func)
+    return name is not None and any(m in name.lower() for m in LOCK_MARKERS)
+
+
+@dataclass
+class AttrEvent:
+    cls: str
+    attr: str
+    write: bool
+    line: int
+    lex_locks: frozenset
+    func: "FuncInfo"
+
+    def lockset(self) -> frozenset:
+        return self.lex_locks | self.func.entry_held
+
+
+@dataclass
+class CallEdge:
+    caller: "FuncInfo"
+    callee: str  # FuncInfo key
+    line: int
+    lex_locks: frozenset
+    callback: bool  # reference passed as an argument: role edge only —
+    #                 it runs LATER, not under the caller's locks
+
+
+@dataclass
+class LockAcq:
+    lock: str
+    line: int
+    lex_held: frozenset  # locks already held lexically at this acquire
+    func: "FuncInfo"
+
+
+@dataclass
+class WaitSite:
+    line: int
+    receiver: str
+    in_loop: bool
+    func: "FuncInfo"
+
+
+@dataclass
+class FuncInfo:
+    key: str  # "func" / "Cls.m" / "Cls.m.<locals>.run"
+    name: str
+    node: ast.AST
+    cls: Optional[str] = None
+    handler: bool = False
+    public: bool = False
+    seeds: set = field(default_factory=set)
+    roles: set = field(default_factory=set)
+    entry_held: frozenset = frozenset()
+    may_held: frozenset = frozenset()
+
+
+class FileModel:
+    """All interprocedural facts for one parsed module."""
+
+    def __init__(self, pf: PyFile):
+        self.pf = pf
+        self.funcs: dict[str, FuncInfo] = {}
+        # class name -> {handler, attr_types, methods, outer (func key of
+        # the enclosing function for class-in-closure definitions)}
+        self.classes: dict[str, dict] = {}
+        self.edges: list[CallEdge] = []
+        self.attr_events: list[AttrEvent] = []
+        self.lock_acqs: list[LockAcq] = []
+        self.waits: list[WaitSite] = []
+        self.thread_targets: dict[str, int] = {}  # func key -> seed line
+        self._collect(self.pf.tree.body, cls=None, prefix="",
+                      outer_func=None)
+        self._record_ctor_types()
+        self._visit_all()
+        self._compute_roles()
+        self._compute_locksets()
+
+    # -- structure collection --------------------------------------------
+
+    def _collect(self, body, cls, prefix, outer_func) -> None:
+        """Register every function/method/nested def and every class
+        (including classes defined inside functions — the
+        ``_make_handler`` factory idiom)."""
+        stack = list(body)
+        while stack:
+            node = stack.pop(0)
+            if isinstance(node, _FUNC_NODES):
+                key = prefix + node.name
+                if key not in self.funcs:
+                    self.funcs[key] = FuncInfo(
+                        key=key, name=node.name, node=node, cls=cls,
+                        handler=bool(cls and self.classes.get(
+                            cls, {}).get("handler")),
+                        public=not node.name.startswith("_"))
+                    if cls is not None:
+                        self.classes[cls]["methods"].add(node.name)
+                    self._collect(list(ast.iter_child_nodes(node)),
+                                  cls=cls, prefix=key + ".<locals>.",
+                                  outer_func=key)
+            elif isinstance(node, ast.ClassDef):
+                if node.name not in self.classes:
+                    handler = any((_terminal(b) or "") in _HANDLER_BASES
+                                  for b in node.bases)
+                    self.classes[node.name] = {
+                        "handler": handler, "attr_types": {},
+                        "methods": set(), "outer": outer_func}
+                    self._collect(node.body, cls=node.name,
+                                  prefix=node.name + ".",
+                                  outer_func=outer_func)
+            else:
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _record_ctor_types(self) -> None:
+        """``self.x = Ctor(...)`` in a constructor records x's type —
+        the race pass exempts thread-safe stdlib containers by it."""
+        for info in self.funcs.values():
+            if info.cls is None or info.name not in _CTOR_NAMES:
+                continue
+            for node in ast.walk(info.node):
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and isinstance(node.targets[0].value, ast.Name)
+                        and node.targets[0].value.id == "self"
+                        and isinstance(node.value, ast.Call)):
+                    ctor = _terminal(node.value.func)
+                    if ctor:
+                        self.classes[info.cls]["attr_types"].setdefault(
+                            node.targets[0].attr, ctor)
+
+    def attr_type(self, cls: str, attr: str) -> Optional[str]:
+        return self.classes.get(cls, {}).get("attr_types", {}).get(attr)
+
+    # -- resolution helpers ----------------------------------------------
+
+    def _annotation_class(self, ann: Optional[ast.AST]) -> Optional[str]:
+        name = _terminal(ann) if ann is not None else None
+        return name if name in self.classes else None
+
+    def _var_env(self, fn: ast.AST, outer: dict) -> dict:
+        """name -> class for params (by annotation) and locals assigned
+        from a module-class constructor; ``outer`` is the enclosing
+        function's env (closures see it)."""
+        env = dict(outer)
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                c = self._annotation_class(a.annotation)
+                if c:
+                    env[a.arg] = c
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                ctor = _terminal(node.value.func)
+                if ctor in self.classes:
+                    env[node.targets[0].id] = ctor
+        return env
+
+    def _outer_env_key(self, info: FuncInfo) -> Optional[str]:
+        """The function whose env this function's closure sees: the
+        lexical parent for nested defs, the enclosing function for
+        methods of a class defined inside one."""
+        parent = info.key.rsplit(".<locals>.", 1)[0]
+        if parent != info.key:
+            return parent
+        if info.cls is not None:
+            return self.classes.get(info.cls, {}).get("outer")
+        return None
+
+    def _resolve(self, expr: ast.AST, info: FuncInfo,
+                 env: dict) -> Optional[str]:
+        """Resolve a callable reference to a FuncInfo key, or None."""
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            # nested sibling first (defined in this or the parent scope),
+            # then module scope, then a class constructor
+            for scope in (info.key, self._outer_env_key(info)):
+                if scope:
+                    sib = f"{scope}.<locals>.{n}"
+                    if sib in self.funcs:
+                        return sib
+            if n in self.funcs:
+                return n
+            if n in self.classes and f"{n}.__init__" in self.funcs:
+                return f"{n}.__init__"
+            return None
+        if isinstance(expr, ast.Attribute):
+            recv, meth = expr.value, expr.attr
+            cls = None
+            if isinstance(recv, ast.Name):
+                cls = info.cls if recv.id == "self" else env.get(recv.id)
+            if cls and meth in self.classes.get(cls, {}).get("methods", ()):
+                return f"{cls}.{meth}"
+        return None
+
+    def _lock_id(self, expr: ast.AST, info: FuncInfo, env: dict) -> str:
+        """Canonical lock identity: per-class for attribute locks (so
+        ``self.cond`` in the class and ``stream.cond`` at a typed use
+        site unify), module-scoped for bare names, source text
+        otherwise."""
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)):
+            recv = expr.value.id
+            if recv == "self" and info.cls:
+                return f"{info.cls}.{expr.attr}"
+            cls = env.get(recv)
+            if cls:
+                return f"{cls}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            return f"<module>.{expr.id}"
+        return ast.unparse(expr)
+
+    # -- the per-function visit ------------------------------------------
+
+    def _visit_all(self) -> None:
+        envs: dict[str, dict] = {}
+        for key, info in self.funcs.items():
+            outer_key = self._outer_env_key(info)
+            env = self._var_env(info.node, envs.get(outer_key or "", {}))
+            envs[key] = env
+            self._visit_body(info, env)
+
+    def _visit_body(self, info: FuncInfo, env: dict) -> None:
+        def walk(node: ast.AST, held: tuple, loops: int) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES + (ast.Lambda,
+                                                    ast.ClassDef)):
+                    continue  # separate FuncInfo / runs later
+                h, lp = held, loops
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    for item in child.items:
+                        if _is_lockish(item.context_expr):
+                            lock = self._lock_id(item.context_expr, info,
+                                                 env)
+                            self.lock_acqs.append(LockAcq(
+                                lock, child.lineno, frozenset(h), info))
+                            h = h + (lock,)
+                elif isinstance(child, (ast.While, ast.For)):
+                    lp = loops + 1
+                elif isinstance(child, ast.Call):
+                    self._record_call(child, info, env, frozenset(h), lp)
+                elif isinstance(child, ast.Attribute):
+                    self._record_attr(child, info, env, frozenset(h))
+                elif isinstance(child, (ast.Assign, ast.Delete,
+                                        ast.AugAssign)):
+                    # subscript store/delete/aug-assign through an
+                    # attribute mutates the attribute's container:
+                    # self.d[k] = v / del self.d[k] / self.d[k] += 1
+                    targets = ([child.target]
+                               if isinstance(child, ast.AugAssign)
+                               else child.targets)
+                    for t in targets:
+                        if (isinstance(t, ast.Subscript)
+                                and isinstance(t.value, ast.Attribute)):
+                            self._record_attr(t.value, info, env,
+                                              frozenset(h),
+                                              force_write=True)
+                walk(child, h, lp)
+
+        walk(info.node, (), 0)
+
+    def _attr_owner(self, node: ast.Attribute, info: FuncInfo,
+                    env: dict) -> Optional[str]:
+        if isinstance(node.value, ast.Name):
+            if node.value.id == "self":
+                return info.cls
+            return env.get(node.value.id)
+        return None
+
+    def _record_attr(self, node: ast.Attribute, info: FuncInfo, env: dict,
+                     held: frozenset, force_write: bool = False) -> None:
+        cls = self._attr_owner(node, info, env)
+        if cls is None:
+            return
+        write = force_write or isinstance(node.ctx, (ast.Store, ast.Del))
+        self.attr_events.append(AttrEvent(
+            cls, node.attr, write, node.lineno, held, info))
+
+    def _record_call(self, node: ast.Call, info: FuncInfo, env: dict,
+                     held: frozenset, loops: int) -> None:
+        fname = _terminal(node.func)
+        # thread seed: threading.Thread(target=f) — f runs on a NEW role
+        if fname == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    key = self._resolve(kw.value, info, env)
+                    if key is not None:
+                        self.thread_targets.setdefault(key, node.lineno)
+            return
+        # condition waits: Cond.wait() must sit under a re-checking loop
+        if (fname == "wait" and isinstance(node.func, ast.Attribute)
+                and "cond" in ast.unparse(node.func.value).lower()):
+            self.waits.append(WaitSite(node.lineno,
+                                       ast.unparse(node.func.value),
+                                       loops > 0, info))
+        # mutator-method write: self.x.append(v) mutates attribute x
+        if (fname in MUTATOR_METHODS
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Attribute)):
+            self._record_attr(node.func.value, info, env, held,
+                              force_write=True)
+        callee = self._resolve(node.func, info, env)
+        if callee is not None:
+            self.edges.append(CallEdge(info, callee, node.lineno, held,
+                                       callback=False))
+        # callback references: a known function passed as an argument runs
+        # in the CONSUMER's thread — a role edge, never a lock edge
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                key = self._resolve(arg, info, env)
+                if key is not None and key != callee:
+                    self.edges.append(CallEdge(info, key, node.lineno,
+                                               frozenset(), callback=True))
+
+    # -- role + lockset dataflow -----------------------------------------
+
+    def _compute_roles(self) -> None:
+        incoming: dict[str, int] = {k: 0 for k in self.funcs}
+        for e in self.edges:
+            if e.callee in incoming:
+                incoming[e.callee] += 1
+        for key, info in self.funcs.items():
+            if key in self.thread_targets:
+                info.seeds.add(f"thread:{key}")
+            if info.handler:
+                info.seeds.add("handler")
+            elif info.public or (incoming[key] == 0
+                                 and key not in self.thread_targets):
+                info.seeds.add("main")
+            info.roles = set(info.seeds)
+        changed = True
+        while changed:
+            changed = False
+            for e in self.edges:
+                callee = self.funcs.get(e.callee)
+                if callee is None:
+                    continue
+                add = e.caller.roles - callee.roles
+                if add:
+                    callee.roles |= add
+                    changed = True
+
+    def _compute_locksets(self) -> None:
+        universe = frozenset(a.lock for a in self.lock_acqs)
+        # entry-held: optimistic intersection over non-callback call
+        # sites; a function that is itself an entry (has a role seed of
+        # its own) can be called with nothing held
+        entry: dict[str, Optional[frozenset]] = {
+            k: (frozenset() if self.funcs[k].seeds else None)
+            for k in self.funcs}
+        sites: dict[str, list[CallEdge]] = {}
+        for e in self.edges:
+            if not e.callback and e.callee in self.funcs:
+                sites.setdefault(e.callee, []).append(e)
+        for _ in range(len(self.funcs) + 2):
+            changed = False
+            for key in self.funcs:
+                if not sites.get(key):
+                    if entry[key] is None:
+                        entry[key] = frozenset()
+                        changed = True
+                    continue
+                meet = frozenset() if self.funcs[key].seeds else None
+                for e in sites[key]:
+                    ce = entry.get(e.caller.key)
+                    held = e.lex_locks | (ce if ce is not None else universe)
+                    meet = held if meet is None else (meet & held)
+                if meet is not None and meet != entry[key]:
+                    entry[key] = meet
+                    changed = True
+            if not changed:
+                break
+        for key, info in self.funcs.items():
+            info.entry_held = entry[key] or frozenset()
+        # may-held: increasing union over call sites (deadlock analysis
+        # must assume any caller's held set can be live)
+        for _ in range(len(self.funcs) + 2):
+            changed = False
+            for e in self.edges:
+                if e.callback:
+                    continue
+                callee = self.funcs.get(e.callee)
+                if callee is None:
+                    continue
+                add = e.lex_locks | e.caller.may_held
+                if not add <= callee.may_held:
+                    callee.may_held = callee.may_held | add
+                    changed = True
+            if not changed:
+                break

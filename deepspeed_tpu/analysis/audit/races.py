@@ -1,0 +1,78 @@
+"""thread-race: instance attributes mutated from ≥2 thread roles with no
+common lock across their accesses.
+
+This is the exact bug class PRs 13/14 paid review rounds for: the
+gateway's serve-loop stall, the abandoned-submit undo, the ``shutdown()``
+vs concurrent-retire snapshot — every one was an attribute shared between
+a handler/boot/heartbeat thread and the owning loop, caught by a human
+reading the diff. The model's thread roles and lock sets make the same
+argument mechanically: if the write sites of ``self.x`` span two roles
+and no single lock is held at every access, the interleaving argument the
+reviewer would demand does not exist in the source.
+
+Deliberate exemptions (each is a reviewable modelling decision, not a
+blind spot):
+
+  * ``__init__``/``__new__``/``__post_init__`` writes — construction
+    happens-before any thread can see the instance.
+  * attributes whose constructor type is a thread-safe stdlib container
+    (``queue.Queue``, ``threading.Event``, locks, ``deque`` — see
+    ``model.SAFE_ATTR_TYPES``): their mutators carry their own locking.
+  * read-only sharing — an attribute written from ONE role and read from
+    others is the publish pattern; flagging it would bury the mutations
+    this pass exists for.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, rule
+from .model import _CTOR_NAMES, SAFE_ATTR_TYPES, FileModel
+
+
+@rule("thread-race",
+      "instance attribute mutated from >=2 inferred thread roles with no "
+      "common lock held at every access — the PR 13/14 review-caught race "
+      "class, machine-encoded; fix with a lock or pragma the argued-safe "
+      "sites", scope="audit")
+def check_thread_race(fm: FileModel) -> list[Finding]:
+    by_attr: dict[tuple, list] = {}
+    for ev in fm.attr_events:
+        if ev.func.name in _CTOR_NAMES:
+            continue
+        by_attr.setdefault((ev.cls, ev.attr), []).append(ev)
+    out = []
+    for (cls, attr), events in sorted(by_attr.items()):
+        writes = [e for e in events if e.write]
+        if not writes:
+            continue
+        atype = fm.attr_type(cls, attr)
+        if atype in SAFE_ATTR_TYPES:
+            continue
+        roles = set()
+        for e in writes:
+            roles |= e.func.roles
+        if len(roles) < 2:
+            continue
+        common = None
+        for e in events:
+            ls = e.lockset()
+            common = ls if common is None else (common & ls)
+            if not common:
+                break
+        if common:
+            continue
+        unlocked = sorted({e.line for e in events if not e.lockset()})
+        writes = sorted(writes, key=lambda e: e.line)
+        sites = ", ".join(f"line {e.line} ({e.func.key})"
+                          for e in writes[:4])
+        # anchor at the FIRST write by line number (not collection order):
+        # a stable anchor keeps the suppressing pragma's placement
+        # deterministic under method reordering
+        out.append(Finding(
+            "thread-race", fm.pf.rel, writes[0].line,
+            f"{cls}.{attr} is mutated from roles "
+            f"{{{', '.join(sorted(roles))}}} with no common lock across "
+            f"its accesses (writes: {sites}; unlocked access lines: "
+            f"{unlocked[:6]}) — guard every access with one lock, or "
+            f"pragma with the interleaving argument"))
+    return out

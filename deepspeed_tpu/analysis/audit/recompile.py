@@ -1,0 +1,288 @@
+"""recompile hazards: the RecompileWatchdog's runtime contract, checked
+statically at the jit boundary.
+
+PAPER.md pillar 5's kernel-inventory discipline is enforced at RUNTIME by
+the watchdog (a compile-stable program that recompiles warns/refuses) —
+but only after the recompile already burned seconds of latency in tier-1
+or, worse, on a serving fleet. These three rules catch the two source
+patterns every historical recompile traced back to, before execution:
+
+  * ``recompile-hazard`` — a shape-derived Python value (``len(...)``,
+    ``.shape``) flowing into a call of a compiled-program reference (an
+    attribute/name assigned from ``jax.jit``/``donated_jit``/
+    ``shard_map``/``watch(...)``) with no bucketing step in the
+    expression. Every distinct length mints a distinct operand shape —
+    the unbounded-program-set failure the chunked-prefill bucketing
+    (``_bucket_len``/``_next_pow2``) exists to prevent.
+  * ``program-key-fork`` — a program name built with an f-string/
+    ``format``/``%``/concat passed to ``watch(...)``/``unique_name(...)``
+    interpolating something that is not visibly a bounded bucket
+    quantity: each distinct key value forks the watchdog's program
+    inventory, unboundedly if the value is request-derived.
+  * ``static-arg-hazard`` — ``static_argnums``/``static_argnames``
+    naming a parameter with a mutable/unhashable default (list/dict/set):
+    jit hashes static arguments, so the default either crashes at first
+    omission or — with a custom hash — silently aliases cache entries.
+    Also flags an index beyond the wrapped function's signature.
+
+Like the rest of the audit tier these are syntactic over-approximations:
+boundedness is recognised by the repo's own naming discipline
+(``bucket``/``width``/``pad``/``pow2``/``bits``/``depth``); a site whose
+boundedness lives elsewhere carries a pragma making that argument.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from ..core import Finding, rule
+from .model import FileModel, _terminal, class_spans, owning_class
+
+# call-expression terminal names that BUILD a compiled program
+_JIT_BUILDERS = frozenset({"jit", "donated_jit", "pjit", "shard_map",
+                           "watch"})
+# program-key registration surfaces (the watchdog inventory)
+_KEY_SINKS = frozenset({"watch", "unique_name"})
+
+# an interpolated/bucketed expression is "visibly bounded" when its
+# source mentions one of the repo's bucketing disciplines
+_BOUNDED_RE = re.compile(r"bucket|width|pad|pow2|bits|depth|block|chunk",
+                         re.IGNORECASE)
+_SHAPEY_RE = re.compile(r"\blen\s*\(|\.shape\b|\.size\b")
+
+_MUTABLE_DEFAULTS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+
+
+def _is_builder_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _terminal(node.func) in _JIT_BUILDERS)
+
+
+def _contains_builder(node: ast.AST) -> bool:
+    return any(_is_builder_call(n) for n in ast.walk(node))
+
+
+def _program_refs(fm: FileModel) -> tuple[dict, set]:
+    """(class -> attrs holding compiled programs, bare names holding
+    them). An attr counts when ANY method assigns it (or a subscript of
+    it) from an expression containing a jit-builder call."""
+    attrs: dict[str, set] = {}
+    names: set = set()
+    for node in ast.walk(fm.pf.tree):
+        if not isinstance(node, ast.Assign) or not _contains_builder(
+                node.value):
+            continue
+        for t in node.targets:
+            base = t.value if isinstance(t, ast.Subscript) else t
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"):
+                cls = _owning_class(fm, node.lineno)
+                if cls:
+                    attrs.setdefault(cls, set()).add(base.attr)
+            elif isinstance(base, ast.Name):
+                names.add(base.id)
+    return attrs, names
+
+
+def _owning_class(fm: FileModel, lineno: int) -> Optional[str]:
+    # class spans computed once per file (this runs per call site)
+    ranges = getattr(fm, "_class_ranges", None)
+    if ranges is None:
+        ranges = fm._class_ranges = class_spans(fm.pf.tree)
+    return owning_class(ranges, lineno)
+
+
+def _callee_is_program(node: ast.Call, attrs: dict, names: set,
+                       fm: FileModel) -> bool:
+    f = node.func
+    if isinstance(f, ast.Subscript):
+        f = f.value  # self._prefills[bucket](...) — the container is the ref
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == "self"):
+        cls = _owning_class(fm, node.lineno)
+        return bool(cls and f.attr in attrs.get(cls, ()))
+    if isinstance(f, ast.Name):
+        return f.id in names
+    # jax.jit(fn, ...)(operands): the builder called inline
+    return _is_builder_call(f)
+
+
+@rule("recompile-hazard",
+      "shape-derived Python value (len()/.shape/.size) flows into a call "
+      "of a compiled program with no bucketing step in the expression — "
+      "every distinct length is a new XLA program (the chunked-prefill "
+      "bucketing discipline, checked before runtime)", scope="audit")
+def check_recompile_hazard(fm: FileModel) -> list[Finding]:
+    attrs, names = _program_refs(fm)
+    out = []
+    for node in ast.walk(fm.pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _callee_is_program(node, attrs, names, fm):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            src = ast.unparse(arg)
+            if _SHAPEY_RE.search(src) and not _BOUNDED_RE.search(src):
+                out.append(Finding(
+                    "recompile-hazard", fm.pf.rel, node.lineno,
+                    f"compiled-program call receives shape-derived "
+                    f"operand `{src}` with no bucketing step — each "
+                    f"distinct value/shape compiles a new program; route "
+                    f"it through the bucket helper, or pragma with the "
+                    f"boundedness argument"))
+    return out
+
+
+def _dynamic_key_problem(arg: ast.AST) -> Optional[str]:
+    """Why a program-key argument can fork the inventory, or None."""
+    if isinstance(arg, ast.JoinedStr):
+        for v in arg.values:
+            if isinstance(v, ast.FormattedValue):
+                src = ast.unparse(v.value)
+                if not _BOUNDED_RE.search(src):
+                    return f"interpolates `{src}`"
+        return None
+    if (isinstance(arg, ast.Call) and _terminal(arg.func) == "format"):
+        # same boundedness bar as the f-string branch: "...".format(bucket)
+        # is the identical key, differently spelled
+        for v in list(arg.args) + [kw.value for kw in arg.keywords]:
+            src = ast.unparse(v)
+            if not _BOUNDED_RE.search(src):
+                return f"formats in `{src}`"
+        return None
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, (ast.Mod, ast.Add)):
+        # same boundedness bar again for "%"-/"+"-built keys. Judge the
+        # TOP-LEVEL operands (chained +/% flattened, a %-tuple unpacked),
+        # like the f-string branch judges whole interpolations — a deep
+        # walk would test interior nodes (the bare `str` of
+        # `str(n_bucket)`) and flag fully-bucketed keys
+        def _operands(n):
+            if isinstance(n, ast.BinOp) and isinstance(n.op,
+                                                       (ast.Mod, ast.Add)):
+                yield from _operands(n.left)
+                yield from _operands(n.right)
+            elif isinstance(n, ast.Tuple):
+                yield from n.elts
+            else:
+                yield n
+
+        for v in _operands(arg):
+            if isinstance(v, ast.Constant):
+                continue
+            src = ast.unparse(v)
+            if not _BOUNDED_RE.search(src):
+                return f"concatenates/%-formats in `{src}`"
+    return None
+
+
+@rule("program-key-fork",
+      "f-string/format-built program key passed to watch()/unique_name() "
+      "interpolating a value that is not a visibly bounded bucket "
+      "quantity — each distinct key forks the watchdog program "
+      "inventory, unboundedly if request-derived", scope="audit")
+def check_program_key_fork(fm: FileModel) -> list[Finding]:
+    out = []
+    for node in ast.walk(fm.pf.tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal(node.func) in _KEY_SINKS):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords
+                                      if kw.arg in (None, "name")]:
+            why = _dynamic_key_problem(arg)
+            if why is not None:
+                out.append(Finding(
+                    "program-key-fork", fm.pf.rel, node.lineno,
+                    f"program key {why} — distinct values fork the "
+                    f"compiled-program inventory; interpolate only "
+                    f"bucketed quantities, or pragma with the "
+                    f"boundedness argument"))
+    return out
+
+
+def _wrapped_params(fn_node) -> list:
+    a = fn_node.args
+    params = list(a.posonlyargs) + list(a.args)
+    return params
+
+
+def _defaults_by_param(fn_node) -> dict:
+    a = fn_node.args
+    params = _wrapped_params(fn_node)
+    out = {}
+    for p, d in zip(params[len(params) - len(a.defaults):], a.defaults):
+        out[p.arg] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            out[p.arg] = d
+    return out
+
+
+def _resolve_wrapped(fm: FileModel, expr: ast.AST):
+    """The wrapped function's def/lambda node, when spelled locally."""
+    if isinstance(expr, ast.Lambda):
+        return expr
+    if isinstance(expr, ast.Call) and _terminal(expr.func) == "partial" \
+            and expr.args:
+        return _resolve_wrapped(fm, expr.args[0])
+    if isinstance(expr, ast.Name):
+        for info in fm.funcs.values():
+            if info.name == expr.id:
+                return info.node
+    return None
+
+
+@rule("static-arg-hazard",
+      "static_argnums/static_argnames naming a parameter with a mutable/"
+      "unhashable default (or an index beyond the wrapped signature) — "
+      "jit hashes static arguments; this crashes at first omission or "
+      "silently aliases cache entries", scope="audit")
+def check_static_arg_hazard(fm: FileModel) -> list[Finding]:
+    out = []
+    for node in ast.walk(fm.pf.tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal(node.func) in ("jit", "donated_jit", "pjit")):
+            continue
+        static_kw = [k for k in node.keywords
+                     if k.arg in ("static_argnums", "static_argnames")]
+        if not static_kw or not node.args:
+            continue
+        fn_node = _resolve_wrapped(fm, node.args[0])
+        if fn_node is None:
+            continue
+        params = _wrapped_params(fn_node)
+        defaults = _defaults_by_param(fn_node)
+        for kw in static_kw:
+            vals = (kw.value.elts if isinstance(kw.value, ast.Tuple)
+                    else [kw.value])
+            for v in vals:
+                if not isinstance(v, ast.Constant):
+                    continue
+                if kw.arg == "static_argnums":
+                    if not isinstance(v.value, int):
+                        continue
+                    if v.value >= len(params):
+                        out.append(Finding(
+                            "static-arg-hazard", fm.pf.rel, node.lineno,
+                            f"static_argnums index {v.value} is beyond "
+                            f"the wrapped function's {len(params)} "
+                            f"positional parameter(s)"))
+                        continue
+                    pname = params[v.value].arg
+                else:
+                    pname = str(v.value)
+                d = defaults.get(pname)
+                if d is not None and (isinstance(d, _MUTABLE_DEFAULTS)
+                                      or (isinstance(d, ast.Call)
+                                          and _terminal(d.func) in
+                                          ("list", "dict", "set"))):
+                    out.append(Finding(
+                        "static-arg-hazard", fm.pf.rel, node.lineno,
+                        f"static parameter {pname!r} has a mutable/"
+                        f"unhashable default `{ast.unparse(d)}` — jit "
+                        f"hashes static args; make the default hashable "
+                        f"or pass the value explicitly"))
+    return out

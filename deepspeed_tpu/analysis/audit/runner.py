@@ -1,0 +1,89 @@
+"""run_audit: the audit tier's tree walker.
+
+Mirrors ``core.run_lint`` — same file discovery, same pragma machinery,
+same ``LintResult``/baseline types (one finding schema for both tools) —
+but builds a ``FileModel`` per file and runs the ``audit``-scope rules
+over it. Kept separate from ``run_lint`` because the model build is the
+expensive step and the two tools gate different things: lint is per-line
+law, audit is whole-program law.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional
+
+from .. import core
+from .model import FileModel
+
+
+def audit_rules() -> dict[str, core.Rule]:
+    # import-for-registration (same lazy pattern as run_lint); the lint
+    # rule modules are imported too so pragmas naming THEIR ids inside
+    # audited files validate instead of reading as unknown
+    from .. import checkers as _checkers  # noqa: F401
+    from .. import drift as _drift  # noqa: F401
+    from . import locks as _locks  # noqa: F401
+    from . import races as _races  # noqa: F401
+    from . import recompile as _recompile  # noqa: F401
+
+    return {rid: r for rid, r in core.RULES.items() if r.scope == "audit"}
+
+
+def run_audit(target: str,
+              rule_ids: Optional[list[str]] = None) -> core.LintResult:
+    """Audit ``target`` (a package directory, or one .py file) with the
+    selected audit rules (default: all). Returns the shared
+    ``LintResult``; suppressed findings are kept separately."""
+    available = audit_rules()
+    if rule_ids is None:
+        selected = dict(available)
+    else:
+        unknown = [r for r in rule_ids if r not in available]
+        if unknown:
+            raise KeyError(f"unknown audit rule id(s): {', '.join(unknown)}")
+        selected = {r: available[r] for r in rule_ids}
+
+    target = os.path.abspath(target)
+    root = target if os.path.isdir(target) else os.path.dirname(target)
+    project = core.Project(root)
+
+    raw: list[core.Finding] = []
+    pragma_cache: dict[str, core.Pragmas] = {}
+
+    for path in core._iter_py_files(target):
+        rel = project.rel(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            raw.append(core.Finding(core.PARSE_RULE, rel, 1,
+                                    f"unreadable: {e}"))
+            continue
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            raw.append(core.Finding(core.PARSE_RULE, rel, e.lineno or 1,
+                                    f"syntax error: {e.msg}"))
+            continue
+        pf = core.PyFile(path, rel, source, tree)
+        project.files.append(pf)
+        pragmas = core._parse_py_pragmas(source, rel)
+        pragma_cache[rel] = pragmas
+        raw.extend(pragmas.findings)
+        fm = FileModel(pf)
+        for r in selected.values():
+            if r.fn is not None:
+                raw.extend(r.fn(fm))
+
+    result = core.LintResult(files_checked=len(project.files),
+                             rules_run=sorted(selected) + [
+                                 core.PARSE_RULE, core.PRAGMA_RULE])
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        pragmas = pragma_cache.get(f.path)
+        if pragmas is not None and pragmas.suppresses(f):
+            result.suppressed.append(f)
+        else:
+            result.findings.append(f)
+    return result

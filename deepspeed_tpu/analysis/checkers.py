@@ -198,12 +198,58 @@ def _lockish(expr: ast.AST) -> bool:
     return name is not None and "lock" in name.lower()
 
 
+def _is_blocking(call: ast.Call) -> bool:
+    name = _terminal_name(call.func)
+    return name in _BLOCKING_CALLS or _root_name(call.func) == "subprocess"
+
+
+def _local_callees(pf: PyFile):
+    """Same-file call resolution index: module functions by name, class
+    methods by (class, name), and class line spans (to resolve ``self.m``
+    at a use site; spans shared with the audit tier —
+    ``audit.model.class_spans``). Deliberately LIGHTER than the audit's
+    FileModel (no roles/locks/typed receivers): this rule only needs
+    one-level same-file dispatch, and lint must stay fast. Built once per
+    file, on first need."""
+    from .audit.model import class_spans
+
+    funcs: dict[str, ast.AST] = {}
+    methods: dict[tuple, ast.AST] = {}
+    for node in pf.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods.setdefault((node.name, item.name), item)
+    return funcs, methods, class_spans(pf.tree)
+
+
+def _resolve_local_call(call: ast.Call, index, lineno: int):
+    """The same-file def a call dispatches to, or None."""
+    from .audit.model import owning_class
+
+    funcs, methods, spans = index
+    f = call.func
+    if isinstance(f, ast.Name):
+        return funcs.get(f.id)
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.value.id == "self"):
+        cls = owning_class(spans, lineno)
+        if cls is not None:
+            return methods.get((cls, f.attr))
+    return None
+
+
 @rule("blocking-under-lock",
       "time.sleep / socket recv/accept / subprocess.* / block_until_ready "
-      "lexically inside a `with <lock>:` body is a stall/deadlock hazard "
-      "(router, RPC and supervisor threads share these locks)")
+      "inside a `with <lock>:` body — lexically, OR reached one call "
+      "level down through a same-file function — is a stall/deadlock "
+      "hazard (router, RPC and supervisor threads share these locks)")
 def check_blocking_under_lock(pf: PyFile) -> list[Finding]:
     out = []
+    index = None
     for node in ast.walk(pf.tree):
         if not isinstance(node, (ast.With, ast.AsyncWith)):
             continue
@@ -215,15 +261,33 @@ def check_blocking_under_lock(pf: PyFile) -> list[Finding]:
         for inner in _walk_same_scope(node):
             if not isinstance(inner, ast.Call):
                 continue
-            name = _terminal_name(inner.func)
-            blocked = name in _BLOCKING_CALLS
-            blocked = blocked or _root_name(inner.func) == "subprocess"
-            if blocked:
+            if _is_blocking(inner):
                 out.append(Finding(
                     "blocking-under-lock", pf.rel, inner.lineno,
                     f"{ast.unparse(inner.func)}(...) inside `with "
                     f"{lock_src}:` — a blocked holder stalls every waiter; "
                     f"move the blocking call outside the critical section"))
+                continue
+            # one call level deep: a same-file callee that blocks runs
+            # UNDER this lock too (PR 15: the per-line rule missed every
+            # helper-wrapped sleep; the audit tier's call graph closes it)
+            if index is None:
+                index = _local_callees(pf)
+            callee = _resolve_local_call(inner, index, inner.lineno)
+            if callee is None:
+                continue
+            hit = min((n for n in _walk_same_scope(callee)
+                       if isinstance(n, ast.Call) and _is_blocking(n)),
+                      key=lambda n: n.lineno, default=None)
+            if hit is not None:
+                out.append(Finding(
+                    "blocking-under-lock", pf.rel, inner.lineno,
+                    f"{ast.unparse(inner.func)}(...) inside `with "
+                    f"{lock_src}:` reaches blocking "
+                    f"{ast.unparse(hit.func)}(...) at line {hit.lineno} "
+                    f"(one call level down) — a blocked holder stalls "
+                    f"every waiter; move the call outside the critical "
+                    f"section"))
     return out
 
 

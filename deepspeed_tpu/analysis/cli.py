@@ -15,15 +15,17 @@ against a frozen finding set and fails only on NEW findings (incremental
 adoption); --write-baseline FILE freezes the current findings. The final
 tree keeps an EMPTY baseline — every finding is fixed or pragma'd
 (docs/analysis.md).
+
+The driver (argparse surface, path checks, baseline ratchet, text/json
+printing) is ``core.cli_main``, shared verbatim with ``audit/cli.py`` —
+this module contributes only the lint-specific catalog, rule-id
+validation, and runner.
 """
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
-import sys
-import time
+from typing import Optional
 
 from . import core
 
@@ -33,112 +35,44 @@ def _default_target() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _print_text(result: core.LintResult, baselined: int,
-                elapsed: float, out) -> None:
-    for f in result.findings:
-        print(f"{f.location}: [{f.rule}] {f.message}", file=out)
-    n = len(result.findings)
-    verdict = "clean" if n == 0 else f"{n} finding(s)"
-    extras = [f"{result.files_checked} files",
-              f"{len(result.rules_run)} rules",
-              f"{len(result.suppressed)} suppressed",
-              f"{elapsed * 1000.0:.0f}ms"]
-    if baselined:
-        extras.append(f"{baselined} baselined")
-    print(f"dstpu-lint: {verdict} — {', '.join(extras)}", file=out)
+def _print_rules() -> None:
+    width = max(len(r) for r in core.RULES)
+    for rid in sorted(core.RULES):
+        r = core.RULES[rid]
+        print(f"{rid:<{width}}  [{r.scope}] {r.doc}")
+
+
+def _validate_rules(rule_ids: list[str]) -> Optional[str]:
+    # audit-scope ids live in the shared registry (pragma validation) but
+    # never run here — selecting one is a loud usage error with a
+    # redirect, not a silent "clean"
+    unknown = [r for r in rule_ids
+               if r not in core.RULES or core.RULES[r].scope == "audit"]
+    if not unknown:
+        return None
+    audit_ids = [r for r in unknown if r in core.RULES]
+    hint = (f"; {', '.join(audit_ids)} are audit-scope — use "
+            f"bin/dstpu_audit" if audit_ids else "")
+    return (f"unknown rule id(s): {', '.join(unknown)} "
+            f"(see --list-rules){hint}")
 
 
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(
-        prog="dstpu_lint",
-        description="deepspeed_tpu invariant checker (docs/analysis.md)")
-    ap.add_argument("paths", nargs="*",
-                    help="package dirs or .py files (default: the "
-                         "deepspeed_tpu package)")
-    ap.add_argument("--rule", action="append", default=None,
-                    help="run only this rule id (repeatable / comma list)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
-    ap.add_argument("--baseline", default=None, metavar="FILE",
-                    help="fail only on findings NOT in this frozen set")
-    ap.add_argument("--write-baseline", default=None, metavar="FILE",
-                    help="freeze the current findings and exit 0")
-    ap.add_argument("--list-rules", action="store_true",
-                    help="print the rule catalog and exit")
-    args = ap.parse_args(argv)
-
     # rules register on import (run_lint does this too; --list-rules needs
-    # the registry populated before any lint runs)
+    # the registry populated before any lint runs — audit-scope rules
+    # included, so lint recognises audit pragmas as known ids)
+    from . import audit as _audit  # noqa: F401
     from . import checkers as _checkers  # noqa: F401
     from . import drift as _drift  # noqa: F401
 
-    if args.list_rules:
-        width = max(len(r) for r in core.RULES)
-        for rid in sorted(core.RULES):
-            r = core.RULES[rid]
-            print(f"{rid:<{width}}  [{r.scope}] {r.doc}")
-        return 0
-
-    rule_ids = None
-    if args.rule:
-        rule_ids = [r.strip() for spec in args.rule
-                    for r in spec.split(",") if r.strip()]
-        unknown = [r for r in rule_ids if r not in core.RULES]
-        if unknown:
-            print(f"dstpu_lint: unknown rule id(s): {', '.join(unknown)} "
-                  f"(see --list-rules)", file=sys.stderr)
-            return 2
-
-    paths = args.paths or [_default_target()]
-    for p in paths:
-        if not os.path.exists(p):
-            print(f"dstpu_lint: no such path: {p}", file=sys.stderr)
-            return 2
-
-    baseline = None
-    if args.baseline is not None:
-        try:
-            baseline = core.load_baseline(args.baseline)
-        except (OSError, ValueError, json.JSONDecodeError) as e:
-            print(f"dstpu_lint: unreadable baseline {args.baseline}: {e}",
-                  file=sys.stderr)
-            return 2
-
-    t0 = time.monotonic()
-    merged = core.LintResult()
-    for p in paths:
-        res = core.run_lint(p, rule_ids=rule_ids)
-        merged.findings.extend(res.findings)
-        merged.suppressed.extend(res.suppressed)
-        merged.files_checked += res.files_checked
-        merged.rules_run = sorted(set(merged.rules_run) | set(res.rules_run))
-    elapsed = time.monotonic() - t0
-
-    if args.write_baseline is not None:
-        core.write_baseline(args.write_baseline, merged.findings)
-        print(f"dstpu_lint: wrote {len(merged.findings)} finding(s) to "
-              f"{args.write_baseline}")
-        return 0
-
-    baselined = 0
-    if baseline is not None:
-        new = [f for f in merged.findings
-               if f.fingerprint() not in baseline]
-        baselined = len(merged.findings) - len(new)
-        merged.findings = new
-
-    if args.format == "json":
-        print(json.dumps({
-            "findings": [f.to_dict() for f in merged.findings],
-            "suppressed": len(merged.suppressed),
-            "baselined": baselined,
-            "files_checked": merged.files_checked,
-            "rules_run": merged.rules_run,
-            "elapsed_s": round(elapsed, 4),
-        }, indent=1))
-    else:
-        _print_text(merged, baselined, elapsed, sys.stdout)
-    return 1 if merged.findings else 0
+    return core.cli_main(
+        argv, tool="dstpu-lint",
+        description="deepspeed_tpu invariant checker (docs/analysis.md)",
+        default_target=_default_target(), runner=core.run_lint,
+        print_rules=_print_rules, validate_rules=_validate_rules)
 
 
 if __name__ == "__main__":
+    import sys
+
     sys.exit(main())

@@ -274,17 +274,29 @@ def run_lint(target: str, rule_ids: Optional[list[str]] = None) -> LintResult:
     selected rules (default: all registered). Suppressed findings are kept
     separately so reports can say how much is pragma'd."""
     # checkers register on import; keep this lazy so `core` alone stays
-    # importable by tooling that only wants Finding/baseline helpers
+    # importable by tooling that only wants Finding/baseline helpers.
+    # The audit tier registers too: its rules never run here (scope
+    # "audit"), but pragmas naming its ids must validate as known
+    from . import audit as _audit  # noqa: F401
     from . import checkers as _checkers  # noqa: F401
     from . import drift as _drift  # noqa: F401
 
+    # audit-scope rules live in the registry (pragma validation needs
+    # their ids known) but NEVER run here — selecting one must be a loud
+    # error, not a silent "clean", and rules_run must not claim them
+    lintable = {rid: r for rid, r in RULES.items() if r.scope != "audit"}
     if rule_ids is None:
-        selected = dict(RULES)
+        selected = dict(lintable)
     else:
-        unknown = [r for r in rule_ids if r not in RULES]
+        unknown = [r for r in rule_ids if r not in lintable]
         if unknown:
-            raise KeyError(f"unknown rule id(s): {', '.join(unknown)}")
-        selected = {r: RULES[r] for r in rule_ids}
+            audit_ids = [r for r in unknown
+                         if r in RULES and RULES[r].scope == "audit"]
+            hint = (f" ({', '.join(audit_ids)}: audit-scope — use "
+                    f"bin/dstpu_audit)" if audit_ids else "")
+            raise KeyError(
+                f"unknown rule id(s): {', '.join(unknown)}{hint}")
+        selected = {r: lintable[r] for r in rule_ids}
         # the pseudo-rules ride along: a selected-rule pragma still needs
         # its contract enforced, and an unparseable file is never clean
         selected.setdefault(PRAGMA_RULE, RULES[PRAGMA_RULE])
@@ -366,6 +378,129 @@ def run_lint(target: str, rule_ids: Optional[list[str]] = None) -> LintResult:
         else:
             result.findings.append(f)
     return result
+
+
+# ---------------------------------------------------------------------------
+# the shared machine-readable finding schema (dstpu-lint AND dstpu-audit
+# emit it from --format json, so tooling consumes both with one parser)
+
+
+def result_to_json(tool: str, result: LintResult, *, baselined: int = 0,
+                   elapsed: float = 0.0) -> dict:
+    return {
+        "tool": tool,
+        "schema": "dstpu-findings/1",
+        "findings": [f.to_dict() for f in result.findings],
+        "suppressed": len(result.suppressed),
+        "baselined": baselined,
+        "files_checked": result.files_checked,
+        "rules_run": result.rules_run,
+        "elapsed_s": round(elapsed, 4),
+    }
+
+
+def print_text_result(tool: str, result: LintResult, baselined: int,
+                      elapsed: float, out) -> None:
+    for f in result.findings:
+        print(f"{f.location}: [{f.rule}] {f.message}", file=out)
+    n = len(result.findings)
+    verdict = "clean" if n == 0 else f"{n} finding(s)"
+    extras = [f"{result.files_checked} files",
+              f"{len(result.rules_run)} rules",
+              f"{len(result.suppressed)} suppressed",
+              f"{elapsed * 1000.0:.0f}ms"]
+    if baselined:
+        extras.append(f"{baselined} baselined")
+    print(f"{tool}: {verdict} — {', '.join(extras)}", file=out)
+
+
+def cli_main(argv, *, tool: str, description: str, default_target: str,
+             runner: Callable[..., LintResult],
+             print_rules: Callable[[], None],
+             validate_rules: Callable[[list[str]], Optional[str]]) -> int:
+    """The shared CLI driver behind ``bin/dstpu_lint`` and
+    ``bin/dstpu_audit``: one argparse surface, one 0/1/2 exit contract,
+    one baseline ratchet, one text/json printer — the two tools differ
+    only in rule catalog, rule-id validation, and runner. ``tool`` is the
+    hyphenated display name; messages use the underscored prog form.
+    ``validate_rules`` returns the usage-error message (prog prefix
+    added here) or None."""
+    import argparse
+    import sys
+    import time
+
+    prog = tool.replace("-", "_")  # messages use the underscored form
+    ap = argparse.ArgumentParser(prog=prog, description=description)
+    ap.add_argument("paths", nargs="*",
+                    help="package dirs or .py files (default: the "
+                         "deepspeed_tpu package)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule id (repeatable / comma list)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="fail only on findings NOT in this frozen set")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="freeze the current findings and exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print_rules()
+        return 0
+
+    rule_ids = None
+    if args.rule:
+        rule_ids = [r.strip() for spec in args.rule
+                    for r in spec.split(",") if r.strip()]
+        err = validate_rules(rule_ids)
+        if err is not None:
+            print(f"{prog}: {err}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [default_target]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"{prog}: no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline = None
+    if args.baseline is not None:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"{prog}: unreadable baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    t0 = time.monotonic()
+    merged = LintResult()
+    for p in paths:
+        res = runner(p, rule_ids=rule_ids)
+        merged.findings.extend(res.findings)
+        merged.suppressed.extend(res.suppressed)
+        merged.files_checked += res.files_checked
+        merged.rules_run = sorted(set(merged.rules_run) | set(res.rules_run))
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline is not None:
+        write_baseline(args.write_baseline, merged.findings)
+        print(f"{prog}: wrote {len(merged.findings)} finding(s) to "
+              f"{args.write_baseline}")
+        return 0
+
+    baselined = 0
+    if baseline is not None:
+        new = [f for f in merged.findings if f.fingerprint() not in baseline]
+        baselined = len(merged.findings) - len(new)
+        merged.findings = new
+
+    if args.format == "json":
+        print(json.dumps(result_to_json(
+            tool, merged, baselined=baselined, elapsed=elapsed), indent=1))
+    else:
+        print_text_result(tool, merged, baselined, elapsed, sys.stdout)
+    return 1 if merged.findings else 0
 
 
 # ---------------------------------------------------------------------------

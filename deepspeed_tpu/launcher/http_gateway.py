@@ -227,10 +227,12 @@ class HttpGateway:
             (self.cfg.host, self.cfg.port), handler)
         self._httpd.daemon_threads = True
         self._httpd.timeout = 1.0
-        self._http_thread = threading.Thread(
+        t = threading.Thread(
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
             daemon=True, name=f"dstpu-gw-http-{self.gateway_id}")
-        self._http_thread.start()
+        with self._lock:
+            self._http_thread = t
+        t.start()
         log_dist(f"gateway {self.gateway_id}: listening on {self.address}",
                  ranks=[0])
 
@@ -270,14 +272,21 @@ class HttpGateway:
             t.join(timeout=max(30.0, self.cfg.shutdown_grace_s + 30.0))
 
     def close(self) -> None:
-        """Tear the sockets down (idempotent; ``stop``/``run`` call it)."""
+        """Tear the sockets down (idempotent; ``stop``/``run`` call it).
+        The thread handle is CLAIMED atomically under the lock: the serve
+        loop's exit path and an external ``close()`` may run concurrently,
+        and a check-then-join on the bare attribute could read a handle
+        the other caller just nulled (``None.join`` crash — audit
+        ``thread-race`` finding). The join itself happens outside the
+        lock so a slow HTTP thread never stalls lock waiters."""
         httpd = self._httpd
         if httpd is not None:
             httpd.shutdown()
             httpd.server_close()
-        if self._http_thread is not None:
-            self._http_thread.join(timeout=10.0)
-            self._http_thread = None
+        with self._lock:
+            t, self._http_thread = self._http_thread, None
+        if t is not None:
+            t.join(timeout=10.0)
 
     # -- the serve loop (the ONLY thread that touches the Router) ---------
 
@@ -312,6 +321,7 @@ class HttpGateway:
                     kw = {"idempotency_key": key} if key else {}
                     uid = self.router.submit(cmd["request"], **kw)
                     if key:
+                        # dstpu: allow[thread-race] -- _idem is serve-loop-owned state: every access sits in _drain_cmds/_replay_idempotent, which only the loop executes; the audit's {main, thread} role pair is the run()-inline vs start()-daemon duality — two alternative entries to the ONE loop thread, never both in one process
                         self._idem[key] = uid
                     stream = _Stream(uid)
                     with self._lock:
@@ -392,10 +402,11 @@ class HttpGateway:
     def _close_stream(self, uid: int) -> None:
         with self._lock:
             stream = self._streams.pop(uid, None)
+            open_streams = len(self._streams)
         if stream is not None:
             # wake any handler still waiting so it observes the close
             stream.publish(None, self.router.result(uid))
-        self.telemetry.gauge("gateway/open_streams").set(len(self._streams))
+        self.telemetry.gauge("gateway/open_streams").set(open_streams)
 
     def _publish(self) -> None:
         with self._lock:
@@ -424,6 +435,7 @@ class HttpGateway:
             # Router bug): without this, handler threads would wait on
             # feeds that can never advance and new submits would block
             # their full command timeout against a dead loop
+            # dstpu: allow[thread-race] -- one-way bool published by the dying loop: the store is GIL-atomic, nothing ever writes it back to False, and the handler-side readers poll it on a bounded cadence (0.5s command wait, per-token stream writes) — a lock would add a hot-path acquire to every poll for a flag whose staleness window is already bounded
             self._stopped = True
             with self._lock:
                 streams = list(self._streams.values())
@@ -632,7 +644,9 @@ def _make_handler(gw: HttpGateway):
                 gw.telemetry.counter("gateway/bad_requests").inc()
                 self._reply_json(e.status, {"error": e.message})
                 return
-            if gw._draining:
+            with gw._lock:
+                draining = gw._draining
+            if draining:
                 # SIGTERM discipline: stop ACCEPTING first; in-flight
                 # streams keep draining underneath
                 gw.telemetry.counter("gateway/rejected").inc()

@@ -121,6 +121,7 @@ class WorkerHost:
 
     def tick(self) -> None:
         if self.heartbeat and time.monotonic() - self._hb_last > 0.2:
+            # dstpu: allow[thread-race] -- advisory throttle shared by the serve loop's on_tick and the daemon beat thread: the worst interleaving is two near-simultaneous beats double-touching the heartbeat file (one extra utime); no liveness verdict reads _hb_last — the supervisor judges the FILE's mtime on its own monotonic clock
             self._hb_last = time.monotonic()
             try:
                 os.utime(self.heartbeat, None)

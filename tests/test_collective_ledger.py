@@ -566,6 +566,33 @@ def test_trajectory_usage_errors(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_trajectory_format_json_emits_per_metric_delta_table(tmp_path,
+                                                             capsys):
+    """PR 15: ``--format json`` carries the per-metric delta table the
+    text report only printed inline, so the audit/lint/trajectory trio is
+    uniformly machine-readable. ``--json`` stays as an alias."""
+    traj = _load_trajectory()
+    d = str(tmp_path)
+    _write_rows(d, [
+        {"platform": "tpu", "comparable": True,
+         "tokens_per_sec_per_chip": 100.0, "mfu": 0.5},
+        {"platform": "tpu", "comparable": True,
+         "tokens_per_sec_per_chip": 110.0, "mfu": 0.4},  # -20% mfu
+    ])
+    assert traj.main(["--dir", d, "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)  # stdout is PURE json
+    assert doc["tool"] == "bench_trajectory"
+    by_metric = {x["metric"]: x for x in doc["deltas"]}
+    tok = by_metric["tok/s/chip"]
+    assert tok["from"] == "r01" and tok["to"] == "r02"
+    assert tok["prev"] == 100.0 and tok["value"] == 110.0
+    assert abs(tok["delta_rel"] - 0.1) < 1e-9 and not tok["regressed"]
+    mfu = by_metric["mfu"]
+    assert mfu["regressed"] and mfu["gates"]
+    assert doc["threshold"] == pytest.approx(0.05)
+    assert doc["regressions"] and "mfu" in doc["regressions"][0]
+
+
 def test_trajectory_json_mode(tmp_path, capsys):
     traj = _load_trajectory()
     d = str(tmp_path)
@@ -575,7 +602,8 @@ def test_trajectory_json_mode(tmp_path, capsys):
         {"platform": "cpu", "comparable": False},
     ])
     assert traj.main(["--dir", d, "--json"]) == 0
-    doc = json.loads(capsys.readouterr().out.splitlines()[0])
+    out = capsys.readouterr().out
+    doc = json.loads(out)  # OK verdict goes to stderr in json mode
     assert [r["comparable"] for r in doc["rows"]] == [True, False]
     assert doc["excluded"] == ["r02"]
     assert doc["regressions"] == []

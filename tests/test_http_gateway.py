@@ -403,6 +403,54 @@ def test_sigterm_drain_finishes_streams_rejects_new(request):
     assert status == 503 and body["status"] == "draining"
 
 
+def test_concurrent_close_is_race_free(request):
+    """Regression (dstpu-audit ``thread-race`` on ``_http_thread``): the
+    serve loop's exit path and an external ``close()`` may both tear the
+    gateway down; the old check-then-join could read a handle the other
+    caller just nulled (``None.join`` AttributeError). ``close()`` now
+    CLAIMS the handle atomically under the gateway lock, so any number of
+    concurrent closers is safe and idempotent."""
+    import threading
+
+    router = _FakeRouter()
+    gw = _gw(request, router)
+    gw.trigger_shutdown()  # the loop's own finally will also call close()
+    errors = []
+
+    def closer():
+        try:
+            gw.close()
+        except Exception as e:  # noqa: BLE001 — the regression IS the raise
+            errors.append(e)
+
+    threads = [threading.Thread(target=closer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+    assert not errors, errors
+    assert gw._http_thread is None
+
+
+def test_open_streams_gauge_snapshot_taken_under_lock(request):
+    """Regression (dstpu-audit ``thread-race`` on ``_streams``): the
+    open-streams gauge used to be set from ``len(self._streams)`` AFTER
+    releasing the lock — a concurrent insert could publish a stale count.
+    The count is now snapshotted inside the critical section that popped
+    the stream."""
+    from deepspeed_tpu.launcher.http_gateway import _Stream
+
+    router = _FakeRouter()
+    gw = _gw(request, router)
+    with gw._lock:
+        gw._streams[101] = _Stream(101)
+        gw._streams[102] = _Stream(102)
+    gw._close_stream(101)
+    assert gw.telemetry.gauge("gateway/open_streams").value == 1
+    gw._close_stream(102)
+    assert gw.telemetry.gauge("gateway/open_streams").value == 0
+
+
 def test_healthz_and_metrics_endpoints(request):
     import http.client
 

@@ -55,14 +55,17 @@ def test_registry_has_the_shipped_rules():
 
 
 def test_analysis_package_is_jax_free():
-    # bin/dstpu_lint loads analysis/ by path precisely so it runs without
-    # jax; an `import jax` sneaking into any module would break that
+    # bin/dstpu_lint and bin/dstpu_audit load analysis/ by path precisely
+    # so they run without jax; an `import jax` sneaking into any module
+    # (the audit/ subpackage included) would break that
     adir = os.path.join(PKG, "analysis")
-    for name in os.listdir(adir):
-        if name.endswith(".py"):
-            with open(os.path.join(adir, name)) as f:
-                src = f.read()
-            assert "import jax" not in src, f"analysis/{name} imports jax"
+    for dirpath, _dirnames, filenames in os.walk(adir):
+        for name in filenames:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name)) as f:
+                    src = f.read()
+                rel = os.path.relpath(os.path.join(dirpath, name), PKG)
+                assert "import jax" not in src, f"{rel} imports jax"
 
 
 def test_syntax_error_is_a_finding_not_a_skip(tmp_path):
@@ -233,6 +236,62 @@ def test_blocking_under_lock_names_the_lock_in_multi_item_with(tmp_path):
     res = run_lint(pkg, rule_ids=["blocking-under-lock"])
     (f,) = findings_for(res, "blocking-under-lock")
     assert "self._lock" in f.message and "open(" not in f.message
+
+
+def test_blocking_under_lock_reaches_one_call_level_deep(tmp_path):
+    # PR 15: the same-file call graph closes the helper-wrapped hole —
+    # a `with lock:` body calling a module function or a sibling method
+    # that blocks is the same stall, one frame removed
+    pkg = make_tree(tmp_path, {"x.py": """\
+        import threading
+        import time
+        def nap():
+            time.sleep(0.5)
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def _poll(self, sock):
+                return sock.recv(64)
+            def bad_fn(self):
+                with self._lock:
+                    nap()
+            def bad_method(self, sock):
+                with self._lock:
+                    self._poll(sock)
+    """})
+    res = run_lint(pkg, rule_ids=["blocking-under-lock"])
+    found = findings_for(res, "blocking-under-lock")
+    assert len(found) == 2
+    assert all("one call level down" in f.message for f in found)
+    assert "time.sleep" in found[0].message and "nap" in found[0].message
+    assert "sock.recv" in found[1].message
+
+
+def test_blocking_under_lock_one_level_negatives(tmp_path):
+    # a non-blocking callee, an unresolvable cross-object call, and a
+    # blocking call hidden in the callee's NESTED def (runs later) are
+    # all clean — the extension only reasons about what the same file
+    # proves runs under the lock
+    pkg = make_tree(tmp_path, {"x.py": """\
+        import threading
+        import time
+        def pure(x):
+            return x + 1
+        def deferred():
+            def later():
+                time.sleep(0.5)
+            return later
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def ok(self, other):
+                with self._lock:
+                    pure(1)
+                    deferred()
+                    other.blocking_elsewhere()
+    """})
+    res = run_lint(pkg, rule_ids=["blocking-under-lock"])
+    assert not findings_for(res, "blocking-under-lock")
 
 
 def test_blocking_under_lock_ignores_outside_and_nested_defs(tmp_path):
@@ -737,6 +796,22 @@ def test_cli_exit_0_on_clean_tree(tmp_path):
 def test_cli_exit_2_on_usage_errors(dirty_pkg):
     assert _cli("/no/such/path").returncode == 2
     assert _cli(dirty_pkg, "--rule", "no-such-rule").returncode == 2
+
+
+def test_audit_scope_rules_are_a_lint_usage_error_not_a_silent_clean(
+        dirty_pkg):
+    # the audit ids live in the shared registry (pragma validation), but
+    # lint never RUNS them — selecting one must be a loud exit-2 with a
+    # redirect, never an exit-0 "clean" that reads as assurance
+    proc = _cli(dirty_pkg, "--rule", "thread-race")
+    assert proc.returncode == 2
+    assert "dstpu_audit" in proc.stderr
+    with pytest.raises(KeyError, match="audit-scope"):
+        run_lint(dirty_pkg, rule_ids=["thread-race"])
+    # and a default run's rules_run must not claim the audit rules ran
+    res = run_lint(dirty_pkg)
+    assert "thread-race" not in res.rules_run
+    assert "lock-order" not in res.rules_run
 
 
 def test_cli_rule_selection(dirty_pkg):
