@@ -1712,6 +1712,8 @@ def _drill_stamp():
         "mfu": None,
         "roofline": "unrated:cpu",
         "step_anatomy": None,
+        "spec_acceptance_rate": None,
+        "spec_tokens_per_sec_per_request_ratio": None,
     }
 
 
@@ -1733,6 +1735,10 @@ def _stamp_row(obj, stage):
     obj.setdefault("mfu", None)
     obj.setdefault("roofline", f"unrated:{platform}")
     obj.setdefault("step_anatomy", None)
+    # speculative-decoding stamps (benchmarks/serving_throughput.py): rows
+    # whose run never measured a spec cell carry the keys as labeled nulls
+    obj.setdefault("spec_acceptance_rate", None)
+    obj.setdefault("spec_tokens_per_sec_per_request_ratio", None)
     return obj
 
 

@@ -1234,7 +1234,38 @@ class Router:
         }
         if self._inj is not None:
             out["fault_injection"] = self._inj.stats()
+        spec = self._spec_aggregate()
+        if spec is not None:
+            out["speculation"] = spec
         return out
+
+    def _spec_aggregate(self) -> Optional[dict]:
+        """Fleet-wide speculative-decoding totals summed over every replica
+        that has reported a stats block — in-process engines answer
+        directly, worker processes via the step-reply piggyback cache
+        (``rpc.ReplicaClient.spec_stats``; zero extra RPCs). A dead
+        replica's last-known counts stay in the sum. None when no replica
+        has the feature on."""
+        drafted = accepted = steps = 0
+        enabled = False
+        for r in self._replicas:
+            fn = getattr(r.engine, "spec_stats", None)
+            s = fn() if fn is not None else None
+            if not s:
+                continue
+            enabled = True
+            drafted += int(s.get("drafted", 0))
+            accepted += int(s.get("accepted", 0))
+            steps += int(s.get("verify_steps", 0))
+        if not enabled:
+            return None
+        return {
+            "enabled": True,
+            "drafted": drafted,
+            "accepted": accepted,
+            "verify_steps": steps,
+            "acceptance_rate": (accepted / drafted) if drafted else 0.0,
+        }
 
     def telemetry_snapshot(self) -> dict:
         """The fleet in one call: the router's own registry + per-replica

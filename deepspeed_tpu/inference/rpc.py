@@ -618,6 +618,11 @@ class ReplicaClient:
         # front door exists); other fleets skip the O(tokens^2) wire cost
         self.stream_progress = False
         self._progress: dict[int, list[int]] = {}
+        # last piggybacked speculative-decoding stats block ("spec" on the
+        # step reply; None until the worker reports one / feature off).
+        # Kept across a replica death so the fleet aggregate still counts
+        # the dead worker's accepted tokens.
+        self._spec: Optional[dict] = None
 
     # -- connection / identity ------------------------------------------
 
@@ -714,6 +719,7 @@ class ReplicaClient:
         self._trace_flush.extend(reply.get("trace") or [])
         self._progress = {int(k): [int(t) for t in v]
                           for k, v in (reply.get("progress") or {}).items()}
+        self._spec = reply.get("spec") or self._spec
         uids = [int(u) for u in reply.get("uids") or []]
         self._ack = list(uids)
         return uids
@@ -812,6 +818,13 @@ class ReplicaClient:
         return np.asarray(toks, np.int32)
 
     # -- observability ---------------------------------------------------
+
+    def spec_stats(self) -> Optional[dict]:
+        """The last step-piggybacked speculative-decoding block (drafted /
+        accepted / acceptance_rate ...), mirroring ``ServingEngine.
+        spec_stats`` — served from cache, NEVER the wire (the Router reads
+        it per stats call). None until a step reply carried one."""
+        return self._spec
 
     def telemetry_snapshot(self) -> dict:
         snap = self.rpc.call("telemetry_snapshot", retry_safe=True)

@@ -95,26 +95,21 @@ def apply_top_p_vector(logits, p):
     return jnp.where(enabled[:, None] & (logits < thresh), NEG_INF, logits)
 
 
-def sample_logits_vector(logits, rng, temperature, top_k, top_p):
-    """Per-slot sampling: logits [B, V] with PER-ROW sampler state as arrays
-    (temperature/top_k/top_p all [B]) -> token ids [B] int32.
-
-    Rows with temperature <= 0 take the greedy argmax. Every sampler knob is
-    an array operand, so admitting a request with new sampling params reuses
-    the already-compiled decode step (the ServingEngine contract).
+def _filter_logits_vector(logits, t, k, p):
+    """The shared per-row filter core: scale fp32 ``logits`` [B, V] by
+    temperature ``t`` [B], then mask below the top-k and nucleus thresholds
+    (k/p [B] arrays; <= 0 / >= 1 disable per row). Returns the filtered
+    SCALED logits — the distribution both the decode sampler and the
+    speculative verifier draw from, factored out so the verify programs
+    score drafts against EXACTLY the distribution decode samples from.
 
     ONE [B, V] sort serves both filters (this runs every decode step; the
     O(V log V) sort dominates sampling cost at real vocabs): top-k masks a
     suffix of the descending sort to NEG_INF, which keeps it sorted, so the
     nucleus pass reuses it — identical semantics to applying
     ``apply_top_k_vector`` then ``apply_top_p_vector`` in sequence."""
-    logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1)
-    t = jnp.asarray(temperature, jnp.float32)
     scaled = logits / jnp.maximum(t, 1e-6)[:, None]
     V = scaled.shape[-1]
-    k = jnp.asarray(top_k, jnp.int32)
-    p = jnp.asarray(top_p, jnp.float32)
 
     sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
     kth = jnp.take_along_axis(sorted_desc, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1)
@@ -128,10 +123,86 @@ def sample_logits_vector(logits, rng, temperature, top_k, top_p):
     # all-masked row that categorical resolves as token 0
     keep_sorted = ((cum - probs) < p[:, None]).at[..., 0].set(True)
     pth = jnp.min(jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1, keepdims=True)
-    scaled = jnp.where((p < 1.0)[:, None] & (scaled < pth), NEG_INF, scaled)
+    return jnp.where((p < 1.0)[:, None] & (scaled < pth), NEG_INF, scaled)
 
+
+def sample_logits_vector(logits, rng, temperature, top_k, top_p):
+    """Per-slot sampling: logits [B, V] with PER-ROW sampler state as arrays
+    (temperature/top_k/top_p all [B]) -> token ids [B] int32.
+
+    Rows with temperature <= 0 take the greedy argmax. Every sampler knob is
+    an array operand, so admitting a request with new sampling params reuses
+    the already-compiled decode step (the ServingEngine contract)."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.asarray(temperature, jnp.float32)
+    k = jnp.asarray(top_k, jnp.int32)
+    p = jnp.asarray(top_p, jnp.float32)
+    scaled = _filter_logits_vector(logits, t, k, p)
     drawn = jax.random.categorical(rng, scaled, axis=-1)
     return jnp.where(t <= 0.0, greedy, drawn).astype(jnp.int32)
+
+
+def verify_logits_vector(logits, draft, rng, temperature, top_k, top_p):
+    """Speculative verify over a whole draft block: logits [B, D+1, V]
+    (position j's logits predict the token AFTER draft token j), draft
+    [B, D] int32 proposals, per-row sampler state [B] arrays ->
+
+      accept   [B, D]   bool  — per-position accept verdicts
+      resample [B, D+1] int32 — the token to emit AT a rejection: drawn
+                                from the residual distribution (the
+                                filtered distribution with the rejected
+                                draft token masked out); the final column
+                                (no draft to reject) falls back to clean
+      clean    [B, D+1] int32 — an unconditional sample per position, used
+                                for the bonus token when the draft was
+                                exhausted rather than rejected (sampling
+                                from the residual there would bias toward
+                                not-the-pad-token)
+
+    Greedy rows (temperature <= 0) accept exactly when the draft token IS
+    the argmax, and both resample and clean ARE the argmax — so the emitted
+    stream is bitwise what one-token-at-a-time decode produces. Sampled
+    rows use the standard speculative acceptance rule (Leviathan et al.
+    2023) against a DETERMINISTIC drafter (q(d)=1): accept with probability
+    p(d) under the filtered distribution, else emit the residual sample —
+    the output marginal stays exactly the filtered distribution.
+
+    The host applies the PREFIX rule (stop at the first rejection) and
+    clamps to each row's true draft length; rows drafted shorter than D —
+    or not at all — ride along with pad tokens and emit ``clean`` at their
+    first free position, which is exactly the decode-step sample."""
+    logits = logits.astype(jnp.float32)
+    B, D1, V = logits.shape
+    D = D1 - 1
+    t = jnp.asarray(temperature, jnp.float32)
+    k = jnp.asarray(top_k, jnp.int32)
+    p = jnp.asarray(top_p, jnp.float32)
+    rep = lambda a, dt: jnp.broadcast_to(
+        jnp.asarray(a, dt)[:, None], (B, D1)).reshape(B * D1)
+    filt = _filter_logits_vector(
+        logits.reshape(B * D1, V), rep(t, jnp.float32),
+        rep(k, jnp.int32), rep(p, jnp.float32)).reshape(B, D1, V)
+    greedy = jnp.argmax(logits, axis=-1)  # [B, D1]
+    sampled = (t > 0.0)[:, None]
+
+    probs = jax.nn.softmax(filt, axis=-1)
+    p_draft = jnp.take_along_axis(
+        probs[:, :D], draft[..., None], axis=-1)[..., 0]  # [B, D]
+    r_accept, r_res, r_clean = jax.random.split(rng, 3)
+    u = jax.random.uniform(r_accept, (B, D))
+    accept = jnp.where(sampled, u < p_draft, draft == greedy[:, :D])
+
+    clean_drawn = jax.random.categorical(r_clean, filt, axis=-1)  # [B, D1]
+    clean = jnp.where(sampled, clean_drawn, greedy).astype(jnp.int32)
+    # residual for a deterministic drafter: p with the draft token removed,
+    # renormalized — i.e. the filtered logits with that token masked out
+    masked = jnp.where(jax.nn.one_hot(draft, V, dtype=jnp.bool_),
+                       NEG_INF, filt[:, :D])
+    res_drawn = jax.random.categorical(r_res, masked, axis=-1)  # [B, D]
+    res = jnp.where(sampled, res_drawn, greedy[:, :D])
+    resample = jnp.concatenate([res, clean[:, D:]], axis=1).astype(jnp.int32)
+    return accept, resample, clean
 
 
 def sample_logits(logits, rng, cfg: SamplerConfig, seen=None):

@@ -107,13 +107,14 @@ from ..parallel.sharding import kv_prefix_pool_spec, kv_slot_cache_spec
 from ..resilience import FaultInjector, RequestRejected
 from ..runtime.config import (ChunkedPrefillConfig, FaultInjectionConfig,
                               LedgerConfig, PrefixCacheConfig,
-                              RequestTraceConfig)
+                              RequestTraceConfig, SpeculationConfig)
 from ..telemetry import RequestTracer, Telemetry, hbm_snapshot, tree_bytes
 from ..utils.donation import donated_jit
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
 from .prefix_cache import PrefixIndex
-from .sampling import sample_logits_vector
+from .sampling import sample_logits_vector, verify_logits_vector
+from .speculation import make_drafter
 
 
 def _next_pow2(n: int) -> int:
@@ -277,6 +278,11 @@ class SlotWorker:
         self._decode = None  # jitted lazily (params pytree shapes needed)
         self._prefills: dict[int, object] = {}  # bucket len -> jitted prefill
         self._chunk_progs: dict[int, object] = {}  # chunk width -> jitted chunk
+        # (spec depth, greedy_only) -> jitted verify: two program families
+        # per pow2 bucket — the greedy one skips the filtered-sampling
+        # machinery (argmax is the whole acceptance rule), which on small
+        # models is most of the verify step's cost
+        self._verifies: dict[tuple[int, bool], object] = {}
         self._fetch = None  # jitted prefix pool -> slot copy
         self._store = None  # jitted slot -> prefix pool copy
         self._poison = None  # jitted slot-KV fill (fault injection/scrub)
@@ -316,6 +322,70 @@ class SlotWorker:
         # donation stays on every backend (utils/donation.py is the gate)
         return donated_jit(decode, donate_argnums=(1,),
                            out_shardings=(self._cache_shardings, None, None))
+
+    def _build_verify(self, depth: int, greedy_only: bool = False):
+        cfg = self.cfg
+
+        if greedy_only:
+            # every emitted token is an argmax: the rng key and the
+            # temp/top_k/top_p vectors are DEAD operands, so the greedy
+            # family drops them from its signature — four fewer host
+            # uploads per verify step on a path whose whole point is
+            # shaving per-step cost
+            def verify_greedy(params, cache, toks, pos, wpos, active):
+                logits, cache = tfm.apply_with_cache(
+                    cfg, params, toks, cache, pos, write_pos=wpos)
+                bad = jnp.any(~jnp.isfinite(logits), axis=(1, 2))
+                # acceptance is draft == argmax and every emitted token IS
+                # the argmax — no top-k/top-p sort, no categorical draws,
+                # no residual distribution. On small models the filtered-
+                # sampling machinery across (depth+1) x n_slots positions
+                # is ~3x the whole forward pass, so this family is what
+                # makes CPU/greedy speculation pay for itself.
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                accept = toks[:, 1:] == greedy[:, :depth]
+                on = active[:, None]
+                out = jnp.where(on, greedy, 0)
+                # ONE packed int32 output [n, 2*depth+2] — accept flags,
+                # then the depth+1 argmax tokens, then the bad sentinel —
+                # so the host pays a single device fetch per verify step
+                # instead of four tiny ones
+                packed = jnp.concatenate(
+                    [(accept & on).astype(jnp.int32), out,
+                     bad.astype(jnp.int32)[:, None]], axis=1)
+                return cache, packed
+
+            return donated_jit(verify_greedy, donate_argnums=(1,),
+                               out_shardings=(self._cache_shardings, None))
+
+        def verify(params, cache, toks, pos, wpos, active, rng, temp, top_k, top_p):
+            # toks [n_slots, depth+1]: column 0 is each slot's last sampled
+            # token, columns 1..depth its (padded) draft. The whole block
+            # runs ONE forward pass at positions pos..pos+depth — the
+            # amortization speculative decoding exists for: one weights
+            # read scores depth+1 positions. Draft KV is written at
+            # wpos..wpos+depth as it goes (write-before-attend, exactly the
+            # chunk path's discipline); rejected tail positions hold stale
+            #-but-finite KV that the causal mask hides until later
+            # dispatches overwrite them — the per-slot "rollback" is just
+            # the host not advancing pos past the accepted prefix.
+            # Inactive slots write at Smax.. and beyond: every position of
+            # their block lands out of range and the scatter's mode="drop"
+            # discards it, the same contract decode relies on.
+            logits, cache = tfm.apply_with_cache(
+                cfg, params, toks, cache, pos, write_pos=wpos)
+            # the sentinel spans ALL depth+1 positions: a NaN anywhere in
+            # the block poisons the accept/bonus math for that slot
+            bad = jnp.any(~jnp.isfinite(logits), axis=(1, 2))
+            accept, resample, clean = verify_logits_vector(
+                logits, toks[:, 1:], rng, temp, top_k, top_p)
+            on = active[:, None]
+            return (cache, accept & on, jnp.where(on, resample, 0),
+                    jnp.where(on, clean, 0), bad)
+
+        return donated_jit(verify, donate_argnums=(1,),
+                           out_shardings=(self._cache_shardings,
+                                          None, None, None, None))
 
     def _build_prefill(self, bucket: int):
         cfg = self.cfg
@@ -423,11 +493,11 @@ class SlotWorker:
                 stable=True)
         self._rng, k = jax.random.split(self._rng)
         t0 = time.perf_counter()
+        # host arrays straight into the jitted call (pjit batches the
+        # uploads); dtypes are pinned by the engine's per-slot state arrays
         self._cache, nxt, bad = self._decode(
-            self.params, self._cache, jnp.asarray(last_tok),
-            jnp.asarray(pos), jnp.asarray(wpos, np.int32),
-            jnp.asarray(active), k,
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            self.params, self._cache, last_tok, pos,
+            np.asarray(wpos, np.int32), active, k, temp, top_k, top_p,
         )
         self._decode_steps += 1
         self.step_compiled |= bool(self._decode.last_call_compiled)
@@ -440,6 +510,61 @@ class SlotWorker:
                 time.perf_counter() - t0)
         tm.counter("serving/decode_steps").inc()
         return nxt, bad
+
+    def verify(self, depth: int, toks, pos, wpos, active, temp, top_k, top_p,
+               greedy_only: bool = False, warm: bool = False):
+        """Score every slot's draft block in one forward pass through the
+        ``depth`` verify program — compile-stable programs per pow2 depth
+        bucket (at most two: the all-greedy fast path and the mixed-
+        sampling one), the chunked-prefill discipline applied to decode.
+        Returns host ``(accept, resample, clean, bad)`` arrays
+        ([n, depth] / [n, depth+1] / [n, depth+1] / [n]); the fetch syncs,
+        so the recorded latency is device-true."""
+        tm = self.telemetry
+        key = (depth, greedy_only)
+        if key not in self._verifies:
+            wd = tm.watchdog
+            name = f"serving/verify[{depth}{':greedy' if greedy_only else ''}]"
+            self._verifies[key] = wd.watch(
+                self._build_verify(depth, greedy_only),
+                wd.unique_name(name), stable=True)
+        prog = self._verifies[key]
+        # host arrays go straight into the jitted call: pjit's C++ argument
+        # path uploads them in one batch, and the greedy family's trimmed
+        # signature (no rng/temp/top_k/top_p — dead operands there) skips
+        # both the uploads and the per-step key split
+        t0 = time.perf_counter()
+        wpos = np.asarray(wpos, np.int32)
+        if greedy_only:
+            self._cache, packed = prog(
+                self.params, self._cache, toks, pos, wpos, active)
+            self.step_compiled |= bool(prog.last_call_compiled)
+            p = np.asarray(packed)  # the ONE fetch; syncs the program
+            tokens = p[:, depth:2 * depth + 1]
+            out = (p[:, :depth].astype(bool), tokens, tokens,
+                   p[:, -1].astype(bool))
+        else:
+            self._rng, k = jax.random.split(self._rng)
+            self._cache, accept, resample, clean, bad = prog(
+                self.params, self._cache, toks, pos, wpos, active, k,
+                temp, top_k, top_p)
+            self.step_compiled |= bool(prog.last_call_compiled)
+            out = tuple(np.asarray(x) for x in
+                        jax.device_get((accept, resample, clean, bad)))
+        if warm:
+            # pre-warm dispatch (all slots inactive, writes dropped): it
+            # exists to COMPILE, so it is neither a latency datum nor a
+            # verify step the acceptance accounting should see
+            return out
+        # device-true (the fetch synced); the compiling call is excluded —
+        # same rule as decode: compile/wall_s records it, and folding it in
+        # would make the latency tail pure compile time
+        if not prog.last_call_compiled:
+            tm.histogram("serving/verify_step_sec").observe(
+                time.perf_counter() - t0)
+        tm.counter("serving/verify_steps").inc()
+        tm.counter(f"serving/verify_bucket[{depth}]").inc()
+        return out
 
     def prefill(self, bucket: int, padded, slot: int, true_len: int,
                 temperature: float, top_k: int, top_p: float):
@@ -579,6 +704,14 @@ class SlotWorker:
         if self._chunk_progs:
             out["chunk_prefill"] = {w: int(f._cache_size())
                                     for w, f in sorted(self._chunk_progs.items())}
+        if self._verifies:
+            # keyed by depth; the value folds both sampler families (all-
+            # greedy + mixed), so the bounded-set contract reads "<= 2 per
+            # pow2 bucket"
+            ver: dict[int, int] = {}
+            for (d, _greedy), f in self._verifies.items():
+                ver[d] = ver.get(d, 0) + int(f._cache_size())
+            out["verify"] = dict(sorted(ver.items()))
         if self._fetch is not None:
             out["prefix_fetch"] = int(self._fetch._cache_size())
         if self._store is not None:
@@ -619,6 +752,12 @@ class ServingEngine:
       chunked_prefill     {enabled, chunk_size, chunks_per_step} — admission
                           chunks interleaved with decode
                           (runtime/config.ChunkedPrefillConfig)
+      speculation         {enabled, depth, ngram_min_match, draft_source} —
+                          self-speculative multi-token decoding: host-side
+                          n-gram drafts verified by a pow2-bucketed family
+                          of compiled verify programs; greedy requests keep
+                          bitwise parity with non-speculative decode
+                          (runtime/config.SpeculationConfig; docs/serving.md)
       max_queue_len       bound on ARRIVED not-yet-admitted requests; excess
                           arrivals are load-shed with a typed reason
                           (0 = unbounded; docs/resilience.md)
@@ -649,6 +788,7 @@ class ServingEngine:
                  replica_id: int | str | None = None,
                  prefix_cache: PrefixCacheConfig | dict | None = None,
                  chunked_prefill: ChunkedPrefillConfig | dict | None = None,
+                 speculation: SpeculationConfig | dict | None = None,
                  fault_injection: FaultInjectionConfig | dict | None = None):
         config = dict(config or {})
         config.pop("router", None)  # the Router's block, not this engine's
@@ -688,6 +828,8 @@ class ServingEngine:
             "serving/prefill[", wall_hist="serving/prefill_sec")
         self.telemetry.ledger.bind(
             "serving/chunk_prefill[", wall_hist="serving/chunk_prefill_sec")
+        self.telemetry.ledger.bind(
+            "serving/verify[", wall_hist="serving/verify_step_sec")
         # collective X-ray axis mapping reads the inference mesh (a 1-device
         # mesh simply yields no collectives — anatomy rows stay labeled)
         self.telemetry.ledger.set_mesh_shape(dict(engine.mesh.shape))
@@ -700,6 +842,27 @@ class ServingEngine:
             cp = ChunkedPrefillConfig(**cp)
         self.prefix_cfg: PrefixCacheConfig = pc
         self.chunk_cfg: ChunkedPrefillConfig = cp
+        sp = (speculation if speculation is not None
+              else config.get("speculation", {}))
+        if isinstance(sp, dict):
+            sp = SpeculationConfig(**sp)
+        self.spec_cfg: SpeculationConfig = sp
+        # the drafter is constructed eagerly so a reserved draft_source
+        # fails at engine build, not on the first decode step
+        self._drafter = make_drafter(sp) if sp.enabled else None
+        # host-side acceptance bookkeeping (spec_stats / the step-reply
+        # piggyback): plain ints — no registry read on the hot path
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_steps = 0
+        # per-slot ADAPTIVE draft cap (AIMD over the configured depth):
+        # doubled on a fully-accepted draft, halved on any rejection. A
+        # slot whose output is locally repetitive ramps to full depth in
+        # log2(depth) steps; a slot the drafter keeps mispredicting sits
+        # at cap 1-2, so its verify dispatches ride the CHEAP small pow2
+        # buckets (near decode-step cost) instead of paying the deepest
+        # program for drafts that die at position 0
+        self._spec_len = np.full((n_slots,), 2, np.int32)
 
         # -- degradation knobs (docs/resilience.md) ---------------------
         self.max_queue_len = int(config.get("max_queue_len", 0))
@@ -797,6 +960,8 @@ class ServingEngine:
                         f"block {pc.block}, {pc.insert_policy}]")
         if cp.enabled:
             feat.append(f"chunked_prefill[{cp.chunk_size}]")
+        if sp.enabled:
+            feat.append(f"speculation[depth {sp.depth}, {sp.draft_source}]")
         log_dist(
             f"serving engine: {n} slots x {self.Smax} tokens, cache "
             f"{2 * self.cfg.num_layers * n * self.Smax * self.cfg.hidden_size * jnp.dtype(self.cfg.dtype).itemsize / 1e6:.1f} MB, "
@@ -1203,6 +1368,7 @@ class ServingEngine:
         self._active[slot] = True
         self._pos[slot] = S
         self._last_tok[slot] = first
+        self._spec_len[slot] = 2  # adaptive draft cap re-ramps per request
         self._temp[slot] = req.temperature
         self._top_k[slot] = req.top_k
         self._top_p[slot] = req.top_p
@@ -1451,6 +1617,111 @@ class ServingEngine:
             tm.counter("resilience/failed_requests").inc()
             self._synth_result(req, "failed_nan", slot=slot)
 
+    def _step_decode(self, wpos):
+        """Advance every active slot ONE token through the decode program —
+        the legacy (and speculation-off) device step."""
+        tm = self.telemetry
+        nxt, bad = self.worker.decode(
+            self._last_tok, self._pos, wpos, self._active,
+            self._temp, self._top_k, self._top_p)
+        for slot in range(self.n_slots):
+            if not self._active[slot]:
+                continue
+            st = self._slots[slot]
+            if bad[slot]:
+                # non-finite logits: the slot's KV/state is poisoned. The
+                # sampled token is garbage — discard the request's partial
+                # output, free the slot (host-side transition only) and
+                # requeue for a clean replay. The batch keeps decoding.
+                tm.counter("resilience/nan_logit_faults").inc()
+                req = st.request
+                self._quarantine(slot, req, "decode")
+                self._release_slot(slot)
+                continue
+            tok = int(nxt[slot])
+            st.tokens.append(tok)
+            st.remaining -= 1
+            self._pos[slot] += 1
+            self._last_tok[slot] = tok
+            if tok == st.eos or st.remaining <= 0:
+                self._finish(slot)  # records the uid in _terminal_uids
+
+    def _step_verify(self, drafts: dict[int, np.ndarray], wpos):
+        """Advance every active slot up to ``bucket + 1`` tokens through ONE
+        verify dispatch. The bucket is the pow2 ceiling of the longest real
+        draft this step; shorter-drafted (or draft-less) slots ride along
+        padded and are clamped on the host, so mixed spec/non-spec slots
+        share the step. Rejection "rollback" is positional: ``pos`` simply
+        never advances past the accepted prefix + bonus token, and the
+        rejected tail's stale KV is masked (causally) until overwritten."""
+        tm = self.telemetry
+        bucket = _next_pow2(max(len(d) for d in drafts.values()))
+        toks = np.zeros((self.n_slots, bucket + 1), np.int32)
+        toks[:, 0] = self._last_tok
+        for slot, d in drafts.items():
+            toks[slot, 1:1 + len(d)] = d
+        # every ACTIVE slot greedy (ride-along samplers included) -> the
+        # argmax-only program family; one sampled slot anywhere in the
+        # batch needs the full acceptance-rule machinery for its rows
+        greedy_only = bool(np.all(self._temp[self._active] <= 0.0))
+        accept, resample, clean, bad = self.worker.verify(
+            bucket, toks, self._pos, wpos, self._active,
+            self._temp, self._top_k, self._top_p, greedy_only=greedy_only)
+        self._spec_steps += 1
+        for slot in range(self.n_slots):
+            if not self._active[slot]:
+                continue
+            st = self._slots[slot]
+            if bad[slot]:
+                # same containment as the decode sentinel: a NaN anywhere
+                # in the block means nothing from this dispatch is usable
+                tm.counter("resilience/nan_logit_faults").inc()
+                req = st.request
+                self._quarantine(slot, req, "verify")
+                self._release_slot(slot)
+                continue
+            d = drafts.get(slot)
+            rl = 0 if d is None else len(d)
+            a = 0
+            while a < rl and accept[slot, a]:
+                a += 1
+            # the burst: accepted prefix + ONE token from the first free
+            # position — the residual sample at a true rejection, the clean
+            # sample when the draft was exhausted (a == rl). A draft-less
+            # slot emits clean[0]: exactly the decode-step sample.
+            bonus = int(resample[slot, a]) if a < rl else int(clean[slot, a])
+            burst = [int(x) for x in d[:a]] + [bonus] if rl else [bonus]
+            if rl:
+                # AIMD draft-cap update: a fully-accepted draft doubles the
+                # slot's cap (ramping repetitive output to full depth in
+                # log2(depth) steps); any rejection halves it, parking
+                # mispredicting slots in the cheap small verify buckets
+                self._spec_len[slot] = (
+                    min(self.spec_cfg.depth, 4 * rl) if a == rl
+                    else max(2, rl // 2))
+            self._spec_drafted += rl
+            self._spec_accepted += a
+            tm.counter("serving/spec_drafted").inc(rl)
+            tm.counter("serving/spec_accepted").inc(a)
+            if rl:
+                tm.histogram("serving/spec_acceptance").observe(a / rl)
+            emitted = 0
+            finished = False
+            for tok in burst:
+                # token-by-token so EOS / max_new_tokens truncate the burst
+                # exactly where one-at-a-time decode would have stopped
+                st.tokens.append(tok)
+                st.remaining -= 1
+                self._pos[slot] += 1
+                self._last_tok[slot] = tok
+                emitted += 1
+                if tok == st.eos or st.remaining <= 0:
+                    finished = True
+                    break
+            tm.histogram("serving/spec_burst_tokens").observe(emitted)
+            if finished:
+                self._finish(slot)
+
     def step(self, now: float | None = None, *,
              enforce_deadlines: bool = True) -> list[int]:
         """One scheduler iteration: sweep deadlines and shed queue overflow,
@@ -1513,30 +1784,35 @@ class ServingEngine:
         # ATTENTION position stays self._pos (0 when idle), so the
         # length-aware decode kernel never streams the full cache for them.
         wpos = np.where(self._active, self._pos, np.int32(self.Smax))
-        nxt, bad = self.worker.decode(
-            self._last_tok, self._pos, wpos, self._active,
-            self._temp, self._top_k, self._top_p)
-        for slot in range(self.n_slots):
-            if not self._active[slot]:
-                continue
-            st = self._slots[slot]
-            if bad[slot]:
-                # non-finite logits: the slot's KV/state is poisoned. The
-                # sampled token is garbage — discard the request's partial
-                # output, free the slot (host-side transition only) and
-                # requeue for a clean replay. The batch keeps decoding.
-                tm.counter("resilience/nan_logit_faults").inc()
-                req = st.request
-                self._quarantine(slot, req, "decode")
-                self._release_slot(slot)
-                continue
-            tok = int(nxt[slot])
-            st.tokens.append(tok)
-            st.remaining -= 1
-            self._pos[slot] += 1
-            self._last_tok[slot] = tok
-            if tok == st.eos or st.remaining <= 0:
-                self._finish(slot)  # records the uid in _terminal_uids
+        drafts: dict[int, np.ndarray] = {}
+        if self._drafter is not None:
+            for slot in range(self.n_slots):
+                if not self._active[slot]:
+                    continue
+                st = self._slots[slot]
+                # a draft longer than ``remaining`` could never be fully
+                # emitted AND would write KV past the admission budget —
+                # the cap keeps every verify write inside the slot window.
+                # The adaptive per-slot cap (AIMD, see _spec_len) further
+                # clamps it so mispredicting slots draft shallow/cheap
+                cap = min(self.spec_cfg.depth, st.remaining,
+                          int(self._spec_len[slot]))
+                if cap < 1:
+                    continue
+                d = self._drafter.propose(
+                    np.concatenate([
+                        np.asarray(st.request.prompt, np.int32).reshape(-1),
+                        np.asarray(st.tokens, np.int32)]), cap)
+                if d.size:
+                    drafts[slot] = d
+        if drafts:
+            self._step_verify(drafts, wpos)
+        else:
+            # no slot drafted this step (speculation off, or the histories
+            # have no n-gram match yet): the plain ONE-token decode program
+            # — the non-speculative path stays exercised, and a spec-enabled
+            # engine pays ZERO verify overhead on draft-less steps
+            self._step_decode(wpos)
         if not self._active.any():
             tm.gauge("serving/active_slots").set(0)
         finished = self._terminal_uids
@@ -1596,6 +1872,53 @@ class ServingEngine:
         the feature is off."""
         return self._pfx.stats() if self._pfx is not None else None
 
+    def warm_verify(self, *, sampled: bool = False) -> list[int]:
+        """Compile the speculative verify program family ahead of traffic:
+        one no-op dispatch per pow2 bucket up to ``speculation.depth``
+        (every slot inactive, so each KV write lands past ``Smax`` and the
+        scatter drops it — nothing observable changes). Serving then never
+        pays a verify compile mid-request, the same reason deployments warm
+        prefill buckets. Warms the all-greedy family; ``sampled=True`` adds
+        the mixed-sampler family. Returns the warmed buckets; no-op when
+        speculation is off."""
+        if self._drafter is None:
+            return []
+        buckets, d = [], 1
+        while True:
+            buckets.append(d)
+            if d >= self.spec_cfg.depth:
+                break
+            d *= 2
+        pos = np.zeros(self.n_slots, np.int32)
+        wpos = np.full(self.n_slots, self.worker.Smax, np.int32)
+        off = np.zeros(self.n_slots, bool)
+        for b in buckets:
+            toks = np.zeros((self.n_slots, b + 1), np.int32)
+            for greedy_only in ((True, False) if sampled else (True,)):
+                self.worker.verify(b, toks, pos, wpos, off, self._temp,
+                                   self._top_k, self._top_p,
+                                   greedy_only=greedy_only, warm=True)
+        return buckets
+
+    def spec_stats(self) -> Optional[dict]:
+        """Host-side speculative-decoding view: drafted/accepted token
+        totals, the derived acceptance rate, and verify dispatch count —
+        None when the feature is off. Pure host ints (no registry read);
+        this is the block a worker process piggybacks on its step reply so
+        a Router aggregates fleet acceptance with zero extra RPCs."""
+        if self._drafter is None:
+            return None
+        drafted, accepted = self._spec_drafted, self._spec_accepted
+        return {
+            "enabled": True,
+            "depth": int(self.spec_cfg.depth),
+            "draft_source": self.spec_cfg.draft_source,
+            "verify_steps": int(self._spec_steps),
+            "drafted": int(drafted),
+            "accepted": int(accepted),
+            "acceptance_rate": (accepted / drafted) if drafted else 0.0,
+        }
+
     def telemetry_snapshot(self) -> dict:
         """ONE call that reports everything: the metrics registry (TTFT/TPOT/
         queue/occupancy histograms, admission/eviction/token counters), the
@@ -1612,6 +1935,8 @@ class ServingEngine:
         extra = {}
         if self._pfx is not None:
             extra["prefix_cache"] = self._pfx.stats()
+        if self._drafter is not None:
+            extra["speculation"] = self.spec_stats()
         if self._inj is not None:
             extra["fault_injection"] = self._inj.stats()
         if self.tracer is not None:

@@ -1,0 +1,113 @@
+"""Self-speculative drafting for the serving engine (docs/serving.md
+"Speculative decoding").
+
+Prompt-lookup / n-gram drafting (Saxena 2023, "Prompt Lookup Decoding"):
+the request's OWN prompt+output token history is the draft model. If the
+last ``m`` tokens of the history re-occur earlier in it, the tokens that
+followed that earlier occurrence are proposed as the draft — a pure host
+operation, zero extra parameters, zero device work. The compiled verify
+program (inference/serving.SlotWorker.verify) then scores the whole draft
+in one forward pass; greedy requests keep bitwise parity with
+non-speculative decode because the verifier only ever ACCEPTS tokens the
+model would have emitted anyway.
+
+Drafting is deliberately STATELESS: every step rebuilds its proposal from
+the slot's prompt+tokens, so a Router failover / quarantine requeue that
+replays the request from scratch starts with exactly the draft state a
+fresh request would have — nothing to reset, nothing to double-count.
+
+``draft_source="draft_model"`` is a reserved hook for a small draft model;
+the config validates it (runtime/config.SpeculationConfig) but
+``make_drafter`` rejects it until the model path is wired.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..runtime.config import SpeculationConfig
+
+# longest history suffix the lookup tries to re-find before falling back to
+# shorter ones — matches prompt-lookup practice (long matches first: they
+# are rarer and their continuations far likelier to be accepted)
+MAX_NGRAM = 8
+
+
+class NgramDrafter:
+    """Prompt-lookup drafter: propose up to ``depth`` tokens by matching the
+    history's suffix n-gram against its earlier occurrences."""
+
+    def __init__(self, cfg: SpeculationConfig):
+        self.cfg = cfg
+
+    def propose(self, history: np.ndarray, depth: int) -> np.ndarray:
+        """history [S] int32 (prompt + generated so far) -> draft [k] int32,
+        0 <= k <= depth. Deterministic: the LONGEST suffix match wins, ties
+        broken by the MOST RECENT earlier occurrence that can supply a
+        full-``depth`` continuation (recency tracks the local repetition
+        structure greedy decode actually produces; the full-depth
+        preference keeps loop-period matches from truncating drafts)."""
+        h = np.asarray(history).reshape(-1)
+        S = int(h.shape[0])
+        lo = int(self.cfg.ngram_min_match)
+        if depth < 1 or S < lo + 1:
+            return np.zeros((0,), np.int32)
+        # cheap pre-pass: if even the MINIMUM-length suffix n-gram has no
+        # earlier occurrence, no longer one can — the no-match case (every
+        # non-repetitive decode step) pays one windowed scan, not
+        # MAX_NGRAM of them
+        win = h[: S - 1]
+        if win.shape[0] >= lo:
+            pat = h[S - lo:]
+            eq = win[: win.shape[0] - lo + 1] == pat[0]
+            for j in range(1, lo):
+                eq = eq & (win[j: win.shape[0] - lo + 1 + j] == pat[j])
+            if not eq.any():
+                return np.zeros((0,), np.int32)
+        for m in range(min(MAX_NGRAM, S - 1), lo - 1, -1):
+            pat = h[S - m:]
+            # candidate start positions: occurrences strictly before the
+            # suffix itself (a match AT the suffix is vacuous)
+            win = h[: S - 1]  # ensure >= 1 continuation token exists
+            if win.shape[0] < m:
+                continue
+            # windowed equality: starts[i] <=> h[i : i+m] == pat
+            eq = win[: win.shape[0] - m + 1] == pat[0]
+            for j in range(1, m):
+                eq = eq & (win[j: win.shape[0] - m + 1 + j] == pat[j])
+            starts = np.flatnonzero(eq)
+            if starts.size == 0:
+                continue
+            if starts.size > 1:
+                # majority vote on the FIRST continuation token: outside a
+                # tight loop the history revisits a context with several
+                # different continuations, and the modal one is likelier to
+                # be re-emitted than whatever happened most recently. Ties
+                # keep the recency rule (inside a loop every occurrence
+                # continues identically, so this is a no-op there).
+                nxt = h[starts + m]
+                vals, cnt = np.unique(nxt, return_counts=True)
+                top = vals[cnt == cnt.max()]
+                best = top[0] if top.size == 1 else (
+                    nxt[np.flatnonzero(np.isin(nxt, top))[-1]])
+                starts = starts[nxt == best]
+            # an occurrence only yields the tokens BETWEEN it and the end
+            # of history, so the most recent match (which sits one loop
+            # period before the suffix) caps the draft at the period. Take
+            # the most recent occurrence that can fill the whole depth;
+            # when none can, the earliest one has the longest continuation.
+            full = starts[starts + m + depth <= S]
+            i = int(full[-1]) if full.size else int(starts[0])
+            cont = h[i + m: i + m + depth]
+            if cont.size:
+                return np.asarray(cont, np.int32)
+        return np.zeros((0,), np.int32)
+
+
+def make_drafter(cfg: SpeculationConfig) -> NgramDrafter:
+    """Drafter factory for ``serving.speculation.draft_source``."""
+    if cfg.draft_source == "ngram":
+        return NgramDrafter(cfg)
+    raise NotImplementedError(
+        "serving.speculation.draft_source='draft_model' is a reserved hook — "
+        "only the self-speculative 'ngram' drafter is wired up")

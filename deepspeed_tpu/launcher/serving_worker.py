@@ -213,6 +213,12 @@ class WorkerHost:
             "compiled": self.engine.last_step_compiled,
             **self._state(now),
         }
+        spec = self.engine.spec_stats()
+        if spec is not None:
+            # speculative acceptance counts ride the step reply exactly
+            # like progress/trace — the Router's fleet aggregation costs
+            # zero extra RPCs (a handful of ints; always-on when enabled)
+            reply["spec"] = spec
         if progress:
             # tokens-so-far per decoding slot: the gateway's SSE streams
             # advance from this piggyback — zero extra round trips.

@@ -945,6 +945,51 @@ class GatewayConfig:
 
 
 @dataclass
+class SpeculationConfig:
+    """``serving.speculation`` block (consumed by
+    ``inference/serving.ServingEngine`` + ``inference/speculation.py``;
+    docs/serving.md "Speculative decoding").
+
+    Self-speculative multi-token decoding: a host-side n-gram /
+    prompt-lookup drafter (Saxena 2023 — no draft model) proposes up to
+    ``depth`` tokens per slot from the request's own prompt+output history,
+    and a bounded pow2-bucketed family of compiled verify programs scores
+    the whole draft in ONE forward pass (Leviathan et al. 2023). Greedy
+    requests keep bitwise parity with non-speculative decode.
+
+    - ``enabled``: draft + verify on the serving decode path. Off = the
+      legacy one-token decode program, untouched.
+    - ``depth``: max draft tokens proposed per slot per step. The verify
+      program set is {1, 2, 4, ..., next_pow2(depth)} — bounded like the
+      chunked-prefill width family, never one program per draft length.
+    - ``ngram_min_match``: smallest history suffix (tokens) that must
+      re-occur earlier in prompt+output before the drafter proposes its
+      continuation. Higher = fewer, higher-confidence drafts.
+    - ``draft_source``: ``ngram`` (the host-side self-drafter) or
+      ``draft_model`` (reserved hook for a small draft model — configs
+      validate, but the engine rejects it at construction until wired).
+    """
+
+    enabled: bool = False
+    depth: int = 4
+    ngram_min_match: int = 2
+    draft_source: str = "ngram"
+
+    def __post_init__(self):
+        if self.draft_source not in ("ngram", "draft_model"):
+            raise DeepSpeedConfigError(
+                f"serving.speculation.draft_source must be ngram|draft_model, "
+                f"got {self.draft_source!r}")
+        if self.depth < 1:
+            raise DeepSpeedConfigError(
+                f"serving.speculation.depth must be >= 1, got {self.depth}")
+        if self.ngram_min_match < 1:
+            raise DeepSpeedConfigError(
+                f"serving.speculation.ngram_min_match must be >= 1, "
+                f"got {self.ngram_min_match}")
+
+
+@dataclass
 class RouterConfig:
     """``serving.router`` block (consumed by ``inference/router.Router``;
     docs/serving.md "Multi-replica router").
@@ -1030,6 +1075,7 @@ class ServingConfig:
     slot_quarantine_after: int = 2
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     chunked_prefill: ChunkedPrefillConfig = field(default_factory=ChunkedPrefillConfig)
+    speculation: SpeculationConfig = field(default_factory=SpeculationConfig)
     fault_injection: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
     router: RouterConfig = field(default_factory=RouterConfig)
     gateway: GatewayConfig = field(default_factory=GatewayConfig)
@@ -1043,6 +1089,8 @@ class ServingConfig:
             self.prefix_cache = _build(PrefixCacheConfig, self.prefix_cache)
         if isinstance(self.chunked_prefill, dict):
             self.chunked_prefill = _build(ChunkedPrefillConfig, self.chunked_prefill)
+        if isinstance(self.speculation, dict):
+            self.speculation = _build(SpeculationConfig, self.speculation)
         if isinstance(self.fault_injection, dict):
             self.fault_injection = _build(FaultInjectionConfig, self.fault_injection)
         if isinstance(self.router, dict):

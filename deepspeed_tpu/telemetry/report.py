@@ -344,6 +344,30 @@ def summarize(events: list[dict], top: int = 10) -> str:
                 lines.append(f"  ... +{len(entries) - top} more entries")
         lines.append("")
 
+    # -- speculative decoding -------------------------------------------
+    # acceptance economics (inference/serving.py spec_stats + the
+    # serving/spec_* metrics): drafted vs accepted totals, the acceptance
+    # rate, and the burst-size distribution — "is speculation paying for
+    # its verify steps" is answerable from CI logs
+    sp = snap.get("speculation") if snap is not None else None
+    if sp:
+        lines.append(
+            f"speculative decoding (depth {sp.get('depth', '?')}, "
+            f"source {sp.get('draft_source', '?')}):")
+        lines.append(
+            f"  verify_steps={sp.get('verify_steps', 0)} "
+            f"drafted={sp.get('drafted', 0)} accepted={sp.get('accepted', 0)} "
+            f"acceptance_rate={sp.get('acceptance_rate', 0.0):.1%}")
+        hists = (snap.get("metrics", {}) or {}).get("histograms", {})
+        burst = hists.get("serving/spec_burst_tokens")
+        if burst:
+            lines.append(
+                f"  burst tokens/step: mean={burst.get('mean', 0.0):.2f} "
+                f"p50={burst.get('p50', 0.0):.0f} p90={burst.get('p90', 0.0):.0f} "
+                f"max={burst.get('max', 0.0):.0f} "
+                f"({int(burst.get('count', 0))} verify steps)")
+        lines.append("")
+
     # -- serving router -------------------------------------------------
     # per-replica fleet view (inference/router.py telemetry_snapshot):
     # health state + traffic counts, so a failed-over / drained replica is
@@ -371,6 +395,15 @@ def summarize(events: list[dict], top: int = 10) -> str:
         if cs:
             lines.append("  " + " ".join(
                 f"{k}={v:g}" for k, v in sorted(cs.items())))
+        rsp = rt.get("speculation")
+        if rsp:
+            # fleet-summed acceptance (Router._spec_aggregate): the
+            # per-replica blocks render in their own engine snapshots
+            lines.append(
+                f"  speculation: drafted={rsp.get('drafted', 0)} "
+                f"accepted={rsp.get('accepted', 0)} "
+                f"acceptance_rate={rsp.get('acceptance_rate', 0.0):.1%} "
+                f"verify_steps={rsp.get('verify_steps', 0)}")
         lines.append("")
 
     # -- autoscaler -----------------------------------------------------

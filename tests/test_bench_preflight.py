@@ -28,7 +28,9 @@ def test_stamp_row_platform_and_comparable(bench):
     # real ones
     assert bench._stamp_row({"platform": "tpu"}, "full") == {
         "platform": "tpu", "bench_stage": "full", "comparable": True,
-        "mfu": None, "roofline": "unrated:tpu", "step_anatomy": None}
+        "mfu": None, "roofline": "unrated:tpu", "step_anatomy": None,
+        "spec_acceptance_rate": None,
+        "spec_tokens_per_sec_per_request_ratio": None}
     assert bench._stamp_row({"platform": "cpu"}, "cpu_fallback")["comparable"] is False
     # a row that never ran anywhere stamps platform "none", non-comparable
     row = bench._stamp_row({}, "none")
@@ -200,7 +202,9 @@ def test_drill_rows_carry_the_stamp_contract(bench):
     never mistake a correctness soak for a perf datapoint."""
     stamp = bench._drill_stamp()
     assert stamp == {"platform": "cpu", "comparable": False, "mfu": None,
-                     "roofline": "unrated:cpu", "step_anatomy": None}
+                     "roofline": "unrated:cpu", "step_anatomy": None,
+                     "spec_acceptance_rate": None,
+                     "spec_tokens_per_sec_per_request_ratio": None}
     # the stamp agrees with what _stamp_row would enforce on a cpu row
     stamped = bench._stamp_row(dict(stamp), "drill")
     assert stamped["comparable"] is False

@@ -978,6 +978,83 @@ def test_last_event_id_resume_parity_real_engine(request, tiny_serving_engine):
     assert counters["gateway/idempotent_replays"] == 1
 
 
+class _BurstRouter(_FakeRouter):
+    """A ``_FakeRouter`` whose ``step()`` reveals WHOLE BURSTS — the
+    gateway-side shape of speculative decoding, where one verify step
+    accepts k>1 tokens at once. The schedule is a list of burst sizes
+    applied in order to every stream."""
+
+    def __init__(self, bursts, plan_tokens=None, **kw):
+        super().__init__(**kw)
+        self._bursts = list(bursts)
+        self._burst_i = {}
+        self._plan_tokens = plan_tokens
+
+    def submit(self, request, idempotency_key=None):
+        uid = super().submit(request, idempotency_key)
+        if self._plan_tokens is not None:
+            self.plan[uid] = list(self._plan_tokens)
+        return uid
+
+    def step(self, now=None, enforce_deadlines=True):
+        terminal = []
+        for uid in list(self._owner):
+            i = self._burst_i.get(uid, 0)
+            k = self._bursts[i] if i < len(self._bursts) else 1
+            self._burst_i[uid] = i + 1
+            n = self._revealed[uid] = min(
+                self._revealed[uid] + k, len(self.plan[uid]))
+            if n >= len(self.plan[uid]):
+                del self._owner[uid]
+                self._finish(uid, "ok", n)
+                terminal.append(uid)
+        return terminal
+
+
+def test_speculative_burst_streams_one_event_per_token(request):
+    """Satellite: a k-token accepted burst must still come out of the
+    gateway as ONE SSE ``token`` event per token with monotone
+    token-index ids — bursts change pacing, never framing."""
+    router = _BurstRouter(bursts=[3, 1, 4], plan_tokens=range(40, 48))
+    gw = _gw(request, router)
+    out = _post(gw, {"prompt": [1, 2, 3]})
+    events = _read_sse(out["resp"], out["conn"])
+    toks = [e for e in events if e["event"] == "token"]
+    assert [e["id"] for e in toks] == list(range(8))
+    assert [e["data"]["token"] for e in toks] == list(range(40, 48))
+    done = [e for e in events if e["event"] == "done"][0]["data"]
+    assert done["tokens"] == list(range(40, 48))
+
+
+def test_last_event_id_resumes_mid_burst(request):
+    """Satellite: ``Last-Event-ID`` falling INSIDE an accepted burst
+    still resumes bitwise-identically across a gateway restart — resume
+    ids are token indices, not step indices, so burst boundaries are
+    invisible to the client."""
+    router = _BurstRouter(bursts=[3, 1, 4], plan_tokens=range(40, 48))
+    gw1 = _gw(request, router)
+    out = _post(gw1, {"prompt": [1, 2, 3]},
+                headers={"X-DSTPU-Idempotency-Key": "burst"})
+    events = _read_sse(out["resp"], out["conn"])
+    got = [e["data"]["token"] for e in events if e["event"] == "token"]
+    assert got == list(range(40, 48))
+    gw1.trigger_shutdown()
+    gw1.stop()
+
+    # id 5 lands inside the third burst (boundaries after ids 2, 3, 7)
+    gw2 = _gw(request, router)
+    out2 = _post(gw2, {"prompt": [1, 2, 3]},
+                 headers={"X-DSTPU-Idempotency-Key": "burst",
+                          "Last-Event-ID": "5"})
+    events2 = _read_sse(out2["resp"], out2["conn"])
+    toks2 = [e for e in events2 if e["event"] == "token"]
+    assert [e["id"] for e in toks2] == [6, 7]
+    assert got[:6] + [e["data"]["token"] for e in toks2] == got
+    done2 = [e for e in events2 if e["event"] == "done"][0]["data"]
+    assert done2["tokens"] == got
+    assert len(router.submitted) == 1  # replay, not re-submit
+
+
 def test_supervisor_set_spec_is_durable(tmp_path):
     """``WorkerSupervisor.set_spec`` swaps the spec future spawns boot —
     written tmp+fsync+rename so a crash mid-upgrade can't tear it."""

@@ -94,6 +94,42 @@ def test_failover_mid_prefill_replays_and_never_stores(engine):
     assert alive.engine.compile_counts()["decode"] == 1
 
 
+def test_spec_failover_mid_burst_replays_clean(engine):
+    """replica_dead injected while speculative bursts are in flight: the
+    requeued requests replay with FRESH draft state (drafting is stateless
+    — rebuilt from prompt+tokens each step, so there is nothing to reset),
+    nothing double-emits or double-counts, and every completed stream is
+    bitwise the solo non-speculative greedy output. Watchdog RAISE on both
+    replicas proves the fault added no verify program shapes."""
+    prompts = _prompts([5, 11, 23])
+    refs = [engine.generate(p[None], max_new_tokens=24)[0] for p in prompts]
+    router = _router(engine, fi={"replica_dead_at": [[0, 3]]},
+                     watchdog_mode="raise",
+                     speculation={"enabled": True, "depth": 4})
+    res = router.serve([Request(uid=i, prompt=p, max_new_tokens=24)
+                        for i, p in enumerate(prompts)])
+    for i in range(3):
+        assert res[i].ok, (i, res[i].status)
+        # bitwise parity IS the no-double-emit proof: a replayed stream
+        # that kept any pre-fault burst tokens would be longer than ref
+        np.testing.assert_array_equal(res[i].tokens, refs[i])
+    assert router.replica_states() == {0: "dead", 1: "healthy"}
+    counters = router.telemetry.registry.snapshot()["counters"]
+    assert counters["router/failovers"] >= 1
+    assert counters.get("router/failed_requests", 0) == 0
+    # the fleet aggregate (router_stats speculation block) saw real drafts
+    agg = router.router_stats()["speculation"]
+    assert agg["enabled"] and agg["drafted"] > 0
+    assert agg["accepted"] <= agg["drafted"]
+    # the survivor's program set stayed bounded under the fault
+    for r in router._replicas:
+        if r.state != "dead":
+            counts = r.engine.compile_counts()
+            assert counts["decode"] == 1
+            assert set(counts.get("verify", {})) <= {1, 2, 4}
+            assert all(v == 1 for v in counts.get("verify", {}).values())
+
+
 def test_drain_under_load_loses_nothing(engine):
     """drain_replica under a queued backlog: queued requests migrate to the
     sibling (not failover), in-flight work finishes, the replica detaches,
