@@ -938,14 +938,23 @@ def _gateway_chaos(seed: int) -> int:
     single-engine run, slot AND prefix-pool-ref occupancy back to 0 on
     every live replica, the rolling upgrade completing with all waves
     ``upgraded``, and the RecompileWatchdog in RAISE mode everywhere (ONE
-    decode program per worker). CPU-pinned correctness soak, never a
-    trajectory datapoint."""
+    decode program per worker). The FLIGHT RECORDER rides the whole drill:
+    rings + SLO classification on every worker, rings + incidents on the
+    router — the SIGKILL must leave >=1 autopsy bundle whose timeline
+    shows the dead verdict and the failover storm, ``bin/dstpu_autopsy``
+    must exit 0 on it, and the measured ring-sampling overhead must stay
+    under 1% of decode step wall (the docs/observability.md claim).
+    CPU-pinned correctness soak, never a trajectory datapoint."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tests", ".xla_cache"))
+    import glob
+    import shutil
     import signal
     import socket as socket_mod
     import struct
+    import subprocess
+    import tempfile
     import threading
 
     import jax.numpy as jnp
@@ -958,6 +967,7 @@ def _gateway_chaos(seed: int) -> int:
     from deepspeed_tpu.models.transformer import Model, TransformerConfig
 
     t0 = time.perf_counter()
+    incidents_dir = tempfile.mkdtemp(prefix="dstpu-gw-chaos-incidents-")
     serving_cfg = {
         "n_slots": 2, "max_seq_len": 128, "watchdog_mode": "raise",
         # chunked prefill + prefix cache: the full program inventory under
@@ -965,6 +975,14 @@ def _gateway_chaos(seed: int) -> int:
         "chunked_prefill": {"enabled": True, "chunk_size": 16},
         "prefix_cache": {"enabled": True, "n_slots": 4, "block": 4,
                          "insert_policy": "always", "min_hits": 1},
+        # flight recorder, worker side: rings sampled from the step loop
+        # (flushed to the router over step-reply piggyback) + SLO terminal
+        # classification. Thresholds are generous — this is a CPU soak;
+        # the drill proves the recorder rides along, not that CPUs are
+        # fast. Engine-side incidents stay off: the router-side recorder
+        # owns the drill's bundle story.
+        "timeseries": {"enabled": True, "interval_s": 0.25},
+        "slo": {"enabled": True, "ttft_s": 120.0, "tpot_s": 60.0},
     }
     model_spec = {"vocab_size": 97, "max_seq_len": 128, "num_layers": 2,
                   "num_heads": 4, "hidden_size": 32, "dtype": "float32",
@@ -1001,9 +1019,11 @@ def _gateway_chaos(seed: int) -> int:
     ref_srv = ServingEngine(
         InferenceEngine(model=Model(cfg), config={"dtype": "fp32"}),
         config=serving_cfg)
-    for i in sorted(prompts):
-        ref_srv.submit(mk(i))
-    ref = {u - 1000: r.tokens for u, r in ref_srv.drain().items()}
+    # serve() (not submit+drain): greedy tokens are identical either way,
+    # but serve()'s finite clock drives the ring sampler — this run doubles
+    # as the sampling-overhead probe asserted below
+    ref = {u - 1000: r.tokens
+           for u, r in ref_srv.serve([mk(i) for i in sorted(prompts)]).items()}
 
     # -- the fleet: 3 TCP workers + supervisor + router + gateway ---------
     sup = WorkerSupervisor(
@@ -1020,7 +1040,25 @@ def _gateway_chaos(seed: int) -> int:
     try:
         clients = sup.start()
         router = Router(config={"router": {"replicas": 3, "max_queue_len": 16,
-                                           "health": {"timeout": 60.0}}},
+                                           "health": {"timeout": 60.0}},
+                                # flight recorder, router side: fleet rings
+                                # + replica mirrors, SLO burn tracking, and
+                                # the incident recorder the SIGKILL must
+                                # leave a bundle in
+                                "timeseries": {"enabled": True,
+                                               "interval_s": 0.25},
+                                "slo": {"enabled": True, "ttft_s": 120.0,
+                                        "tpot_s": 60.0},
+                                # window_after_s spans the whole drill: the
+                                # kill, the failover storm, the respawn AND
+                                # the rolling-upgrade waves coalesce into
+                                # ONE bundle, finalized by the force-flush
+                                # below once the upgrade is done — the
+                                # autopsy timeline then shows the full arc
+                                "incidents": {"enabled": True,
+                                              "dir": incidents_dir,
+                                              "window_before_s": 60.0,
+                                              "window_after_s": 600.0}},
                         replica_engines=clients)
         state["slots"] = {0: 0, 1: 1, 2: 2}
         kill_at = [None]  # router-clock kill time, armed once serving
@@ -1222,10 +1260,79 @@ def _gateway_chaos(seed: int) -> int:
                       if e.get("refs")]
             assert not leaked, (r.rid, leaked)
 
+        # -- flight recorder: the SIGKILL left an autopsy bundle ----------
+        # the dead verdict staged replica_dead, the failover storm
+        # coalesced onto it, and step() finalized it window_after_s later;
+        # drain() would force-flush a straggler
+        if router.incidents is not None and router.incidents.pending:
+            router.incidents.flush(router._incident_context)
+        bundles = sorted(glob.glob(os.path.join(incidents_dir,
+                                                "incident-*.json")))
+        assert bundles, "SIGKILL produced no incident bundle"
+        dead_bundles = [p for p in bundles if "replica_dead" in p]
+        assert dead_bundles, ("no replica_dead bundle among", bundles)
+        with open(dead_bundles[0]) as f:
+            bundle = json.load(f)
+        trig_kinds = [t["kind"] for t in bundle["triggers"]]
+        assert trig_kinds[0] == "replica_dead", trig_kinds
+        assert "failover" in trig_kinds, (
+            "the failover storm did not coalesce onto the dead verdict",
+            trig_kinds)
+        assert bundle["rings"]["router"]["series"], (
+            "bundle carries no ring window")
+        assert any(ev.get("event") == "failover"
+                   for ev in bundle.get("trace_events", ())), (
+            "no failover edge in the bundle timeline")
+        # the same bundle correlates the rolling-upgrade waves against the
+        # ring window (context captured post-upgrade by the flush above)
+        assert bundle.get("upgrade", {}).get("state") == "done", (
+            "bundle missing the completed upgrade", bundle.get("upgrade"))
+        assert len(bundle["upgrade"].get("waves", [])) >= 3
+        # the CLI contract the bundle feeds: autopsy renders it, exit 0
+        autopsy = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bin", "dstpu_autopsy")
+        proc = subprocess.run([sys.executable, autopsy, dead_bundles[0]],
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, (proc.returncode, proc.stdout,
+                                      proc.stderr)
+        assert "failover" in proc.stdout, "autopsy timeline lost the story"
+        assert "wave" in proc.stdout, "autopsy timeline lost the upgrade"
+
         snap = gw.telemetry_snapshot()
         counters = snap["router"]["metrics"]["counters"]
         gw_c = {k.split("/", 1)[1]: int(v) for k, v in counters.items()
                 if k.startswith("gateway/")}
+
+        # the ring window spans the upgrade: the snapshot rings + the
+        # upgrade wave log come from the same fleet clock, so the report
+        # CLI / autopsy can correlate the waves against queue-depth cells
+        assert "rings" in snap["router"] and "slo" in snap["router"]
+        assert snap["router"]["incidents"], "snapshot lost the bundle index"
+
+        # measured sampling overhead: ring walk wall vs decode step wall
+        # (the docs/observability.md "<1% of step time" claim is MEASURED
+        # here, not asserted from faith). The LOADED reference engine is
+        # the probe — same sampler, full trace, cannot be retired
+        # mid-drill. Fleet replicas are reported but not asserted: a
+        # near-idle replica keeps sampling on health steps while its
+        # decode denominator stays tiny, so its ratio measures idleness,
+        # not per-step cost
+        ref_reg = ref_srv.telemetry.registry
+        ref_ring = ref_reg.get("serving/ring_sample_sec")
+        ref_step = ref_reg.get("serving/decode_step_sec")
+        assert ref_ring is not None and ref_step is not None
+        overhead_pct = 100.0 * ref_ring.value / ref_step.summary()["sum"]
+        assert overhead_pct < 1.0, (
+            "ring sampling cost >=1% of decode step wall under load",
+            overhead_pct)
+        fleet_overhead_pct = []
+        for rep in snap["replicas"].values():
+            m = rep.get("metrics") or {}
+            ring = (m.get("counters") or {}).get("serving/ring_sample_sec")
+            step = ((m.get("histograms") or {})
+                    .get("serving/decode_step_sec") or {}).get("sum")
+            if ring is not None and step:
+                fleet_overhead_pct.append(round(100.0 * ring / step, 4))
 
         from collections import Counter as _Counter
 
@@ -1250,12 +1357,17 @@ def _gateway_chaos(seed: int) -> int:
             "greedy_bitwise_match_ok_set": True,
             "parity_checked": parity_checked,
             "gateway": gw_c,
+            "incident_bundles": len(bundles),
+            "bundle_triggers": dict(_Counter(trig_kinds)),
+            "ring_sample_overhead_pct": round(overhead_pct, 4),
+            "fleet_ring_sample_pct_incl_idle": fleet_overhead_pct,
             "seed": seed,
             "elapsed_s": round(time.perf_counter() - t0, 2),
         }), flush=True)
         return 0
     finally:
         sup.shutdown()
+        shutil.rmtree(incidents_dir, ignore_errors=True)
 
 
 def _router_chaos_child(cfg_path: str) -> int:

@@ -70,6 +70,7 @@ Deployment models — the SAME Router state machine drives both:
 from __future__ import annotations
 
 import itertools
+import math
 import threading
 import time
 from collections import deque
@@ -81,9 +82,12 @@ import numpy as np
 from ..resilience import (ControlPlaneCrash, FaultInjector, RequestRejected,
                           RpcError, RpcTimeout)
 from ..resilience.retry import backoff_delay
-from ..runtime.config import (FaultInjectionConfig, RequestTraceConfig,
-                              RouterConfig, RouterHealthConfig)
-from ..telemetry import RequestTracer, Telemetry
+from ..runtime.config import (FaultInjectionConfig, IncidentConfig,
+                              RequestTraceConfig, RouterConfig,
+                              RouterHealthConfig, SLOConfig,
+                              TimeSeriesConfig)
+from ..telemetry import (IncidentRecorder, RequestTracer, SLOTracker,
+                         Telemetry, TimeSeriesStore)
 from ..telemetry.request_trace import RESERVED_UID_BASE
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
@@ -276,6 +280,41 @@ class Router:
         # set by enable_stream_progress (an SSE gateway exists): remote
         # replicas piggyback tokens-so-far on step replies
         self._stream_progress = False
+        # -- fleet flight recorder (docs/observability.md "Flight recorder
+        # & SLOs"): router-side rings + per-replica mirror stores rebuilt
+        # from the step-reply cell flush, an SLO tracker over both, and an
+        # incident recorder ticked by step(). SLO/incidents imply rings.
+        ts = config.get("timeseries", {})
+        if isinstance(ts, dict):
+            ts = TimeSeriesConfig(**ts)
+        slo = config.get("slo", {})
+        if isinstance(slo, dict):
+            slo = SLOConfig(**slo)
+        inc = config.get("incidents", {})
+        if isinstance(inc, dict):
+            inc = IncidentConfig(**inc)
+        self.timeseries_cfg: TimeSeriesConfig = ts
+        self.slo_cfg: SLOConfig = slo
+        self.incidents_cfg: IncidentConfig = inc
+        self._rings: Optional[TimeSeriesStore] = (
+            TimeSeriesStore(raw_interval_s=ts.interval_s,
+                            tiers=tuple(ts.tiers), capacity=ts.capacity,
+                            flush_capacity=ts.flush_capacity)
+            if (ts.enabled or slo.enabled or inc.enabled) else None)
+        self._next_sample_t = 0.0
+        # rid -> mirror store fed by ingest of that replica's flushed cells
+        self._ring_mirror: dict = {}
+        self._slo: Optional[SLOTracker] = (
+            SLOTracker(slo, self.telemetry.registry, self._slo_stores)
+            if slo.enabled else None)
+        self._next_slo_t = 0.0
+        self._incidents: Optional[IncidentRecorder] = None
+        if inc.enabled:
+            self._incidents = IncidentRecorder(
+                inc.dir, source="router", max_bundles=inc.max_bundles,
+                window_before_s=inc.window_before_s,
+                window_after_s=inc.window_after_s,
+                registry=self.telemetry.registry)
         if self._journal is not None and self._journal.recovered:
             # cold-start recovery: the journal remembers what the dead
             # control plane promised; the workers remember what they were
@@ -521,12 +560,14 @@ class Router:
         on = bool(on)
         if on and not self._brownout:
             self.telemetry.counter("router/autoscale/brownouts").inc()
+            self._incident("brownout_engaged", deadline_s=float(deadline_s))
             log_dist(
                 "router: BROWNOUT on ("
                 + (f"{deadline_s}s deadline for deadline-free requests, "
                    if deadline_s else "no deadline tightening, ")
                 + "priority shedding armed)", ranks=[0])
         elif not on and self._brownout:
+            self._incident("brownout_lifted")
             log_dist("router: brownout lifted", ranks=[0])
         self._brownout = on
         self._brownout_deadline_s = float(deadline_s) if on else 0.0
@@ -686,6 +727,9 @@ class Router:
             self._failover(req, self._pending_terminal)
             tm.counter("router/recovery/redispatched").inc()
         self._update_gauges()
+        self._incident("journal_recovery", terminals=len(st.terminals),
+                       adopted=len(held), harvested=len(harvested),
+                       redispatched=len(redispatch))
         log_dist(
             f"router: recovered from journal — "
             f"{len(st.terminals)} journaled terminals, "
@@ -756,6 +800,7 @@ class Router:
                 ranks=[0])
             return
         self._failovers[req.uid] = n + 1
+        self._incident("failover", uid=req.uid, from_rid=from_rid)
         tgt = self._pick(targets, req)
         try:
             tgt.engine.requeue(req)
@@ -806,6 +851,8 @@ class Router:
         if verdict == "dead":
             r.state = "dead"
             tm.counter("router/replicas_dead").inc()
+            self._incident("replica_dead", rid=r.rid, in_flight=len(live),
+                           hung_verdicts=r.hung_verdicts)
             closer = getattr(r.engine, "close", None)
             if closer is not None:
                 # a remote replica's client is closed so later snapshots /
@@ -826,6 +873,8 @@ class Router:
                                   seed=self._seed + r.rid)
             r.readmit_at = now + delay
             r.state = "probation"
+            self._incident("replica_hung", rid=r.rid, in_flight=len(live),
+                           verdicts=r.hung_verdicts, probation_s=delay)
             log_dist(
                 f"router: replica {r.rid} HUNG (verdict "
                 f"{r.hung_verdicts}/{self.health.max_attempts}); probation "
@@ -866,6 +915,134 @@ class Router:
         if flush:
             self._trace_mirror.setdefault(
                 r.rid, deque(maxlen=2048)).extend(flush)
+
+    # -- flight recorder (docs/observability.md "Flight recorder & SLOs") -
+
+    def _mirror_rings(self, r: _Replica) -> None:
+        """Ingest the replica's piggybacked closed ring cells into a
+        router-side mirror store — the SLO windows' and incident bundles'
+        only source for a replica whose process is gone."""
+        if self._rings is None:
+            return
+        take = getattr(r.engine, "take_ring_flush", None)
+        if take is None:
+            return
+        try:
+            flush = take()
+        # dstpu: allow[broad-except] -- same contract as _mirror_trace: ring mirroring is observability-only and must never fail a fleet step; the replica's verdict is earned from its step call, not its flush
+        except Exception:  # noqa: BLE001 — rings never fail a step
+            return
+        if not flush:
+            return
+        store = self._ring_mirror.get(r.rid)
+        if store is None:
+            store = self._ring_mirror[r.rid] = TimeSeriesStore(
+                raw_interval_s=self._rings.raw_interval_s,
+                tiers=self._rings.intervals[1:],
+                capacity=self._rings.capacity)
+        for item in flush:
+            if isinstance(item, dict) and "s" in item and "c" in item:
+                store.ingest(str(item["s"]), item["c"])
+
+    def _slo_stores(self) -> list:
+        """Every store the SLO windows sum over: the router's own rings
+        plus each replica mirror (dead replicas' last-flushed cells still
+        count toward attainment — their failures happened)."""
+        stores = [self._rings] if self._rings is not None else []
+        stores.extend(self._ring_mirror.values())
+        return stores
+
+    def _sample_rings(self, now: float) -> None:
+        """Router-side flight-recorder sample: fleet gauges as-is, registry
+        counters as deltas (failovers, verdicts, brownout activity) — one
+        call per raw interval from step()."""
+        if self._rings is None or not math.isfinite(now):
+            return
+        if now < self._next_sample_t:
+            return
+        iv = self._rings.raw_interval_s
+        self._next_sample_t = (math.floor(now / iv) + 1.0) * iv
+        reg = self.telemetry.registry
+        gauges = {
+            "router/queue_depth": float(sum(
+                r.engine.queue_len for r in self._replicas if r.stepped)),
+            "router/healthy_replicas": float(sum(
+                1 for r in self._replicas if r.state == "healthy")),
+            "router/live_requests": float(len(self._owner)),
+            "router/fleet_size": float(len(self._replicas)),
+        }
+        counters = {}
+        for name in ("router/failovers", "router/failed_requests",
+                     "router/hung_verdicts", "router/replicas_dead",
+                     "router/autoscale/brownouts",
+                     "router/autoscale/brownout_shed"):
+            c = reg.get(name)
+            if c is not None:
+                counters[name] = c.value
+        self._rings.sample(now, gauges=gauges, counters=counters)
+
+    def _incident(self, kind: str, **detail) -> None:
+        """Stage (or coalesce onto) an incident at the current fleet time —
+        the one call every trigger site uses; a no-op when the recorder is
+        off, so trigger sites carry no conditionals."""
+        if self._incidents is not None:
+            self._incidents.trigger(kind, self.now(), **detail)
+
+    def _incident_context(self, st: dict, t0: float, t1: float) -> dict:
+        """Router-side incident capture: ring windows (own + mirrors),
+        merged trace events for the window restricted to in-flight and
+        trigger uids, fleet/autoscale/upgrade state, SLO verdict, journal
+        cursor. Host-memory reads ONLY — a dead replica cannot answer an
+        RPC, and capture must never block the serve loop on one."""
+        ctx: dict = {}
+        rings: dict = {}
+        if self._rings is not None:
+            rings["router"] = self._rings.window_snapshot(t0, t1)
+        if self._ring_mirror:
+            rings["replicas"] = {
+                rid: store.window_snapshot(t0, t1)
+                for rid, store in self._ring_mirror.items()}
+        if rings:
+            ctx["rings"] = rings
+        uids = set(self._owner)
+        for ev in st.get("triggers", ()):
+            if "uid" in ev:
+                uids.add(int(ev["uid"]))
+        events: list = []
+        if self.tracer is not None:
+            events.extend(self.tracer.events())
+        for buf in self._trace_mirror.values():
+            events.extend(buf)
+        ctx["trace_events"] = sorted(
+            (dict(ev) for ev in events
+             if t0 <= float(ev.get("t", 0.0)) <= t1
+             and (not uids or int(ev.get("uid", -1)) in uids)),
+            key=lambda ev: (float(ev.get("t", 0.0)), int(ev.get("uid", 0))))
+        ctx["fleet"] = {"replicas": {
+            r.rid: {"state": r.state, "completed": r.completed,
+                    "dispatched": r.dispatched,
+                    "failed_over": r.failed_over,
+                    "hung_verdicts": r.hung_verdicts}
+            for r in self._replicas}}
+        ctx["stats"] = self.router_stats()
+        if self._autoscaler is not None:
+            ctx["autoscale"] = self._autoscaler.describe()
+        if self._upgrade is not None:
+            ctx["upgrade"] = self._upgrade.status()
+        if self._slo is not None and self._slo.last:
+            ctx["slo"] = dict(self._slo.last)
+        if self._journal is not None:
+            ctx["journal"] = {
+                "path": self._journal.path,
+                "live_requests": len(self._journal.state.requests),
+                "terminals": len(self._journal.state.terminals)}
+        return ctx
+
+    @property
+    def incidents(self) -> Optional[IncidentRecorder]:
+        """The router's incident recorder (None when off) — the gateway's
+        ``/debug/incidents`` listing reads ``incidents.index()``."""
+        return self._incidents
 
     # -- stepping --------------------------------------------------------
 
@@ -922,6 +1099,7 @@ class Router:
                 self._fail(r, "dead", now, terminal)
                 continue
             self._mirror_trace(r)
+            self._mirror_rings(r)
             latency = time.perf_counter() - t0
             compiled = r.engine.last_step_compiled
             if self._inj is not None and self._inj.replica_hang(
@@ -961,6 +1139,18 @@ class Router:
         tm.gauge("router/queue_depth").set(
             sum(r.engine.queue_len for r in self._replicas if r.stepped))
         self._update_gauges()
+        self._sample_rings(now)
+        if (self._slo is not None and math.isfinite(now)
+                and now >= self._next_slo_t):
+            self._next_slo_t = now + self._slo.cfg.eval_interval_s
+            verdict = self._slo.evaluate(now)
+            if verdict.get("breach_rising"):
+                self._incident("slo_fast_burn",
+                               dims=verdict.get("breach_dims", []),
+                               burn=verdict.get("burn", {}))
+        if (self._incidents is not None and self._incidents.pending
+                and math.isfinite(now)):
+            self._incidents.tick(now, self._incident_context)
         if self._upgrade is not None and self._upgrade.state == "running":
             self._upgrade.tick(now)
         elif self._autoscaler is not None:
@@ -1057,6 +1247,10 @@ class Router:
         deadlines, like ``ServingEngine.drain``); returns all results."""
         while self._owner:
             self.step(now=float("inf"), enforce_deadlines=False)
+        if self._incidents is not None and self._incidents.pending:
+            # a trigger staged during the final steps would otherwise wait
+            # forever for window_after_s of fleet time that never comes
+            self._incidents.flush(self._incident_context)
         return dict(self._results)
 
     def serve(self, requests: list[Request]) -> dict[int, RequestResult]:
@@ -1267,11 +1461,13 @@ class Router:
             "acceptance_rate": (accepted / drafted) if drafted else 0.0,
         }
 
-    def telemetry_snapshot(self) -> dict:
+    def telemetry_snapshot(self, emit: bool = True) -> dict:
         """The fleet in one call: the router's own registry + per-replica
         ``ServingEngine.telemetry_snapshot()``s, kept under their replica
         ids so counter names never collide across replicas. Appended to the
-        router's JSONL sink (type ``snapshot``) when one is configured."""
+        router's JSONL sink (type ``snapshot``) when one is configured —
+        ``emit=False`` skips that (the gateway's periodic ``/metrics``
+        refresh must not grow the JSONL on a scrape cadence)."""
         reps: dict = {}
         for r in self._replicas:
             try:
@@ -1299,10 +1495,22 @@ class Router:
                    if self._autoscaler is not None else {}),
                 **({"upgrade": self._upgrade.status()}
                    if self._upgrade is not None else {}),
+                **({"rings": {
+                        "router": self._rings.snapshot(),
+                        **({"replicas": {
+                                rid: s.snapshot() for rid, s
+                                in self._ring_mirror.items()}}
+                           if self._ring_mirror else {})}}
+                   if self._rings is not None else {}),
+                **({"slo": dict(self._slo.last)}
+                   if self._slo is not None and self._slo.last else {}),
+                **({"incidents": self._incidents.index()}
+                   if self._incidents is not None else {}),
             },
             "replicas": reps,
         }
-        self.telemetry.emit({"type": "snapshot", **snap})
+        if emit:
+            self.telemetry.emit({"type": "snapshot", **snap})
         return snap
 
 
@@ -1556,6 +1764,7 @@ class _RollingUpgrade:
         accepted requests lost even on the abort path)."""
         self.reason = reason
         self.router.telemetry.counter("router/upgrade_aborts").inc()
+        self.router._incident("upgrade_abort", reason=reason)
         log_dist(f"router: rolling upgrade ABORTED — {reason} (old "
                  "generation keeps serving)", ranks=[0])
         w = self._wave

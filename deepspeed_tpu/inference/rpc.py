@@ -610,6 +610,7 @@ class ReplicaClient:
         self._compiled = False
         self._results: dict[int, object] = {}  # uid -> decoded RequestResult
         self._trace_flush: deque = deque(maxlen=4096)
+        self._ring_flush: deque = deque(maxlen=4096)
         self._ack: list[int] = []  # terminal uids to acknowledge next step
         # per-uid tokens-so-far, refreshed whole by every step reply — the
         # gateway's SSE streams read this cache (partial_tokens), so token
@@ -717,6 +718,7 @@ class ReplicaClient:
         for k, enc in (reply.get("results") or {}).items():
             self._results[int(k)] = decode_result(enc)
         self._trace_flush.extend(reply.get("trace") or [])
+        self._ring_flush.extend(reply.get("rings") or [])
         self._progress = {int(k): [int(t) for t in v]
                           for k, v in (reply.get("progress") or {}).items()}
         self._spec = reply.get("spec") or self._spec
@@ -799,6 +801,16 @@ class ReplicaClient:
         out = []
         while self._trace_flush and len(out) < limit:
             out.append(self._trace_flush.popleft())
+        return out
+
+    def take_ring_flush(self, limit: int = 256) -> list[dict]:
+        """Drain the piggybacked flight-recorder ring cells the step
+        replies delivered (no extra round trip) — the Router ingests these
+        into its per-replica mirror stores so a SIGKILL'd worker's recent
+        history survives for SLO windows and incident bundles."""
+        out = []
+        while self._ring_flush and len(out) < limit:
+            out.append(self._ring_flush.popleft())
         return out
 
     def partial_tokens(self, uid: int):

@@ -91,6 +91,8 @@ Repetition penalty is NOT supported here: its [n_slots, vocab]
 
 from __future__ import annotations
 
+import math
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -106,9 +108,12 @@ from ..models import transformer as tfm
 from ..parallel.sharding import kv_prefix_pool_spec, kv_slot_cache_spec
 from ..resilience import FaultInjector, RequestRejected
 from ..runtime.config import (ChunkedPrefillConfig, FaultInjectionConfig,
-                              LedgerConfig, PrefixCacheConfig,
-                              RequestTraceConfig, SpeculationConfig)
-from ..telemetry import RequestTracer, Telemetry, hbm_snapshot, tree_bytes
+                              IncidentConfig, LedgerConfig, PrefixCacheConfig,
+                              RequestTraceConfig, SLOConfig,
+                              SpeculationConfig, TimeSeriesConfig)
+from ..telemetry import (IncidentRecorder, RequestTracer, Telemetry,
+                         TimeSeriesStore, classify_terminal, hbm_snapshot,
+                         tree_bytes)
 from ..utils.donation import donated_jit
 from ..utils.logging import log_dist
 from .engine import InferenceEngine
@@ -810,12 +815,26 @@ class ServingEngine:
         rt = config.get("request_trace", {})
         if isinstance(rt, dict):
             rt = RequestTraceConfig(**rt)
+        ts = config.get("timeseries", {})
+        if isinstance(ts, dict):
+            ts = TimeSeriesConfig(**ts)
+        slo = config.get("slo", {})
+        if isinstance(slo, dict):
+            slo = SLOConfig(**slo)
+        inc = config.get("incidents", {})
+        if isinstance(inc, dict):
+            inc = IncidentConfig(**inc)
+        self.timeseries_cfg: TimeSeriesConfig = ts
+        self.slo_cfg: SLOConfig = slo
+        self.incidents_cfg: IncidentConfig = inc
         self.telemetry = telemetry if telemetry is not None else Telemetry(
             jsonl_path=config.get("jsonl_path", ""),
             watchdog_mode=config.get("watchdog_mode", "warn"),
             ledger=lc.enabled,
             ledger_collectives=lc.collectives.enabled,
             ici_gbps=lc.collectives.ici_gbps,
+            jsonl_max_bytes=int(config.get("jsonl_max_bytes", 0)),
+            jsonl_keep=int(config.get("jsonl_keep", 3)),
         )
         # program-ledger join rules (telemetry/program_ledger.py): each
         # program family reads its measured wall time from its existing
@@ -954,6 +973,28 @@ class ServingEngine:
             RequestTracer(rt.capacity, replica_id=self.replica_id,
                           clock=lambda: time.perf_counter() - self._epoch)
             if rt.enabled else None)
+        # flight-recorder rings (telemetry/timeseries.py): sampled from the
+        # step loop on the engine clock, flushed over the step-reply
+        # piggyback. SLO classification and incident capture both read the
+        # rings, so enabling either implies them.
+        self._rings: Optional[TimeSeriesStore] = (
+            TimeSeriesStore(raw_interval_s=ts.interval_s,
+                            tiers=tuple(ts.tiers), capacity=ts.capacity,
+                            flush_capacity=ts.flush_capacity)
+            if (ts.enabled or slo.enabled or inc.enabled) else None)
+        self._next_sample_t = 0.0
+        # incident recorder (telemetry/incident.py): per-replica bundles
+        # under <dir>/replica<rid>/ so a fleet's recorders never collide
+        self._incidents: Optional[IncidentRecorder] = None
+        if inc.enabled:
+            self._incidents = IncidentRecorder(
+                os.path.join(inc.dir, f"replica{self.replica_id}"),
+                source=f"replica{self.replica_id}",
+                max_bundles=inc.max_bundles,
+                window_before_s=inc.window_before_s,
+                window_after_s=inc.window_after_s,
+                registry=self.telemetry.registry)
+            self.telemetry.watchdog.on_refusal = self._on_watchdog_refusal
         feat = []
         if pc.enabled:
             feat.append(f"prefix_cache[{pc.n_slots}x{self.worker.pmax}, "
@@ -1193,6 +1234,92 @@ class ServingEngine:
             getattr(self, "_trace_cursor", 0), limit)
         return events
 
+    def take_ring_flush(self, limit: int = 256) -> list[dict]:
+        """Incremental drain of closed flight-recorder ring cells for a
+        Router's per-replica mirror — the ``take_trace_flush`` contract
+        (seq-cursor, bounded, non-destructive) over
+        ``TimeSeriesStore.cells_since``. Empty when rings are off."""
+        if self._rings is None:
+            return []
+        cells, self._ring_cursor = self._rings.cells_since(
+            getattr(self, "_ring_cursor", 0), limit)
+        return cells
+
+    def _on_watchdog_refusal(self, name: str, signature: str) -> None:
+        """First refusal of a compile-stable path -> incident trigger (the
+        watchdog's ``on_refusal`` hook; raise-mode refusals are operational
+        events worth an autopsy bundle, not just a counter)."""
+        if self._incidents is not None:
+            self._incidents.trigger(
+                "watchdog_refusal", time.perf_counter() - self._epoch,
+                program=name, signature=signature)
+
+    def _maybe_sample_rings(self, now: float) -> None:
+        """One flight-recorder sample per configured interval: scheduler
+        gauges as-is, registry counters as deltas, histogram percentile
+        estimates as ring-only series. Off-interval steps pay one float
+        compare; the sampling walk itself is accumulated into the
+        ``serving/ring_sample_sec`` counter so the overhead claim in
+        docs/observability.md stays measured, not asserted."""
+        if self._rings is None or not math.isfinite(now):
+            return
+        if now < self._next_sample_t:
+            return
+        t0 = time.perf_counter()
+        iv = self._rings.raw_interval_s
+        self._next_sample_t = (math.floor(now / iv) + 1.0) * iv
+        reg = self.telemetry.registry
+        gauges = {
+            "serving/queue_depth": float(len(self._queue)),
+            "serving/slot_occupancy": (self.n_active / self.n_slots
+                                       if self.n_slots else 0.0),
+            "serving/prefilling": float(len(self._prefilling)),
+        }
+        if self._pfx is not None:
+            g = reg.get("serving/prefix_pool_used")
+            if g is not None:
+                gauges["serving/prefix_pool_used"] = g.value
+        for hist_name, ring_name, q in (
+                ("serving/ttft_sec", "serving/ttft_p90_s", 0.9),
+                ("serving/tpot_sec", "serving/tpot_p90_s", 0.9),
+                ("serving/decode_step_sec", "serving/decode_step_p50_s", 0.5)):
+            h = reg.get(hist_name)
+            if h is not None and h.count:
+                gauges[ring_name] = h.quantile(q)
+        if self._spec_drafted:
+            gauges["serving/spec_acceptance"] = (
+                self._spec_accepted / self._spec_drafted)
+        counters = {}
+        for name in ("slo/requests", "slo/failures", "slo/ttft_violations",
+                     "slo/tpot_violations", "serving/tokens_out",
+                     "resilience/quarantines"):
+            c = reg.get(name)
+            if c is not None:
+                counters[name] = c.value
+        self._rings.sample(now, gauges=gauges, counters=counters)
+        reg.counter("serving/ring_sample_sec").inc(
+            time.perf_counter() - t0)
+
+    def _incident_context(self, st: dict, t0: float, t1: float) -> dict:
+        """Engine-side incident capture: the ring window around the trigger,
+        the trace events inside it, and a plain registry snapshot. Host
+        dict/deque reads only — no device work, no lazy ledger analysis
+        (this runs on the step loop mid-incident)."""
+        ctx: dict = {"metrics": self.telemetry.registry.snapshot()}
+        if self._rings is not None:
+            ctx["rings"] = self._rings.window_snapshot(t0, t1)
+        if self.tracer is not None:
+            ctx["trace_events"] = [
+                ev for ev in self.tracer.events()
+                if t0 <= float(ev.get("t", 0.0)) <= t1]
+        ctx["scheduler"] = {
+            "queue_depth": len(self._queue),
+            "active": self.n_active,
+            "prefilling": self.n_prefilling,
+            "quarantined_slots": sorted(self._quarantined_slots),
+        }
+        return ctx
+
     @property
     def last_step_compiled(self) -> bool:
         """True if the most recent ``step()`` paid at least one program
@@ -1429,6 +1556,9 @@ class ServingEngine:
                 tm.histogram("serving/tpot_sec").observe(tpot)
         else:
             tpot = 0.0
+        if self.slo_cfg.enabled:
+            classify_terminal(tm.registry, self.slo_cfg, status, res.ttft,
+                              tpot if len(res.tokens) > 1 else None)
         tm.emit({
             "type": "request", "uid": res.uid, "slot": slot,
             "prompt_len": res.prompt_len, "n_tokens": int(len(res.tokens)),
@@ -1476,6 +1606,9 @@ class ServingEngine:
         self._results[req.uid] = res
         self._terminal_uids.append(req.uid)
         self._exempt_uids.discard(req.uid)
+        if self.slo_cfg.enabled:
+            classify_terminal(self.telemetry.registry, self.slo_cfg,
+                              status, 0.0, None)
         self.telemetry.emit({
             "type": "request", "uid": req.uid, "slot": slot,
             "prompt_len": res.prompt_len, "n_tokens": 0, "status": status,
@@ -1591,6 +1724,10 @@ class ServingEngine:
         tm.counter("resilience/quarantines").inc()
         if self.tracer is not None:
             self.tracer.record(req.uid, "quarantine", phase=phase, slot=slot)
+        if self._incidents is not None:
+            self._incidents.trigger(
+                "nan_quarantine", time.perf_counter() - self._epoch,
+                uid=req.uid, slot=slot, phase=phase)
         # scrub before the slot can be reused: NaN KV anywhere in the row
         # poisons later occupants through masked attention (see SlotWorker.fill_slot)
         self.worker.fill_slot(slot, 0.0)
@@ -1738,6 +1875,10 @@ class ServingEngine:
             now = time.perf_counter() - self._epoch
         tm = self.telemetry
         self.worker.step_compiled = False  # fresh heartbeat window
+        self._maybe_sample_rings(now)
+        if self._incidents is not None and self._incidents.pending \
+                and math.isfinite(now):
+            self._incidents.tick(now, self._incident_context)
         if enforce_deadlines:
             if self._deadlines_armed:
                 self._sweep_deadlines(now)
@@ -1827,6 +1968,10 @@ class ServingEngine:
         results so far."""
         while self._queue or self._prefilling or self._active.any():
             self.step(now=float("inf"), enforce_deadlines=False)
+        if self._incidents is not None and self._incidents.pending:
+            # drain's now=inf never ticks the recorder (non-finite clock);
+            # a staged incident must not be lost because the engine idled
+            self._incidents.flush(self._incident_context)
         return dict(self._results)
 
     def serve(self, requests: list[Request]) -> dict[int, RequestResult]:
@@ -1941,6 +2086,10 @@ class ServingEngine:
             extra["fault_injection"] = self._inj.stats()
         if self.tracer is not None:
             extra["request_trace"] = self.tracer.events()
+        if self._rings is not None:
+            extra["rings"] = self._rings.snapshot()
+        if self._incidents is not None:
+            extra["incidents"] = self._incidents.index()
         snap = self.telemetry.snapshot(
             replica_id=self.replica_id,
             compiles=self.compile_counts(),

@@ -213,6 +213,12 @@ class WorkerHost:
             "compiled": self.engine.last_step_compiled,
             **self._state(now),
         }
+        rings = self.engine.take_ring_flush(256)
+        if rings:
+            # closed flight-recorder cells ride the reply like trace —
+            # the Router's mirror ingest costs zero extra RPCs; omitted
+            # when empty (the common off/idle case adds no wire bytes)
+            reply["rings"] = rings
         spec = self.engine.spec_stats()
         if spec is not None:
             # speculative acceptance counts ride the step reply exactly

@@ -268,6 +268,136 @@ class RequestTraceConfig:
 
 
 @dataclass
+class TimeSeriesConfig:
+    """Flight-recorder ring sub-block (``telemetry.timeseries``, mirrored as
+    ``serving.timeseries``; ``telemetry/timeseries.py``,
+    docs/observability.md "Flight recorder & SLOs").
+
+    - ``enabled``: sample the configured metric set into bounded
+      downsampling rings from the owning step/serve loop. Forced on when
+      ``slo`` or ``incidents`` is enabled (both read the rings).
+    - ``interval_s``: raw sampling/bucket interval on the fleet clock.
+    - ``tiers``: coarser bucket intervals (seconds) rebuilt alongside raw;
+      intervals <= ``interval_s`` are dropped.
+    - ``capacity``: cells kept PER TIER per series (fixed deques — memory
+      is O(series x tiers x capacity) regardless of run length).
+    - ``flush_capacity``: closed-raw-cell journal bound for the step-reply
+      piggyback flush (seq-cursor; cells evicted before a flush are lost).
+    """
+
+    enabled: bool = False
+    interval_s: float = 0.25
+    tiers: list = field(default_factory=lambda: [1.0, 10.0, 60.0])
+    capacity: int = 240
+    flush_capacity: int = 4096
+
+    def __post_init__(self):
+        if self.interval_s <= 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.timeseries.interval_s must be > 0, "
+                f"got {self.interval_s}")
+        if self.capacity < 2:
+            raise DeepSpeedConfigError(
+                f"telemetry.timeseries.capacity must be >= 2, "
+                f"got {self.capacity}")
+        if self.flush_capacity < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.timeseries.flush_capacity must be >= 1, "
+                f"got {self.flush_capacity}")
+
+
+@dataclass
+class SLOConfig:
+    """SLO objective sub-block (``telemetry.slo``, mirrored as
+    ``serving.slo``; ``telemetry/slo.py``, docs/observability.md).
+
+    - ``enabled``: classify terminals + evaluate attainment/burn on the
+      rings, publishing the ``slo/*`` gauges.
+    - ``ttft_s`` / ``tpot_s``: per-request latency objectives (seconds);
+      a finished request exceeding one counts as that dimension's
+      violation. 0 disables the dimension's classification.
+    - ``ttft_target`` / ``tpot_target`` / ``availability_target``: the SLO
+      targets in (0, 1] — the error budget is ``1 - target``.
+    - ``window_s``: rolling attainment window on the fleet clock.
+    - ``fast_window_s`` / ``slow_window_s``: the multi-window burn-rate
+      pair (5m/1h analogues, scaled so drills can use second-scale
+      windows).
+    - ``fast_burn_threshold``: fast-window burn at/over which the verdict
+      is a breach (14.4 = the classic "30-day budget gone in ~2 days"
+      page threshold) — an incident trigger on the rising edge.
+    - ``eval_interval_s``: how often the Router re-evaluates.
+    """
+
+    enabled: bool = False
+    ttft_s: float = 0.0
+    tpot_s: float = 0.0
+    ttft_target: float = 0.99
+    tpot_target: float = 0.99
+    availability_target: float = 0.999
+    window_s: float = 300.0
+    fast_window_s: float = 300.0
+    slow_window_s: float = 3600.0
+    fast_burn_threshold: float = 14.4
+    eval_interval_s: float = 1.0
+
+    def __post_init__(self):
+        for name in ("ttft_target", "tpot_target", "availability_target"):
+            v = getattr(self, name)
+            if not 0.0 < v <= 1.0:
+                raise DeepSpeedConfigError(
+                    f"telemetry.slo.{name} must be in (0, 1], got {v}")
+        if self.ttft_s < 0 or self.tpot_s < 0:
+            raise DeepSpeedConfigError(
+                "telemetry.slo.ttft_s/tpot_s must be >= 0")
+        for name in ("window_s", "fast_window_s", "slow_window_s",
+                     "eval_interval_s"):
+            if getattr(self, name) <= 0:
+                raise DeepSpeedConfigError(
+                    f"telemetry.slo.{name} must be > 0, "
+                    f"got {getattr(self, name)}")
+        if self.fast_burn_threshold <= 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.slo.fast_burn_threshold must be > 0, "
+                f"got {self.fast_burn_threshold}")
+
+
+@dataclass
+class IncidentConfig:
+    """Incident-recorder sub-block (``telemetry.incidents``, mirrored as
+    ``serving.incidents``; ``telemetry/incident.py``, docs/observability.md).
+
+    - ``enabled``: stage/finalize durable incident bundles on the typed
+      trigger matrix. Requires ``dir``.
+    - ``dir``: bundle directory (the Router writes here; each replica's
+      engine writes under ``<dir>/replica<rid>/``).
+    - ``max_bundles``: bundle count bound per directory; oldest are
+      LRU-pruned past it (storage stays O(configured capacity)).
+    - ``window_before_s`` / ``window_after_s``: ring/trace capture window
+      around the trigger; finalization waits ``window_after_s`` of fleet
+      time so the aftermath is in the bundle too.
+    """
+
+    enabled: bool = False
+    dir: str = ""
+    max_bundles: int = 32
+    window_before_s: float = 30.0
+    window_after_s: float = 2.0
+
+    def __post_init__(self):
+        if self.enabled and not self.dir:
+            raise DeepSpeedConfigError(
+                "telemetry.incidents.enabled requires telemetry.incidents.dir")
+        if self.max_bundles < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.incidents.max_bundles must be >= 1, "
+                f"got {self.max_bundles}")
+        if self.window_before_s < 0 or self.window_after_s < 0:
+            raise DeepSpeedConfigError(
+                "telemetry.incidents window_before_s/window_after_s "
+                "must be >= 0")
+
+
+@dataclass
 class TelemetryConfig:
     """Unified telemetry block (``deepspeed_tpu/telemetry/``; docs/observability.md).
 
@@ -293,24 +423,51 @@ class TelemetryConfig:
       its own dataclass above).
     - ``request_trace``: per-request lifecycle tracing sub-block (serving
       engines; its own dataclass above).
+    - ``jsonl_max_bytes``: size-based JSONL rotation threshold — when an
+      append would grow the file past it, the file is rename-rotated to
+      ``<path>.1`` (older files shift up) before the append. 0 = never
+      rotate (the pre-rotation behavior).
+    - ``jsonl_keep``: rotated files retained (``.1`` newest); older are
+      deleted.
+    - ``timeseries`` / ``slo`` / ``incidents``: flight-recorder sub-blocks
+      (their own dataclasses above; docs/observability.md "Flight
+      recorder & SLOs").
     """
 
     enabled: bool = False
     jsonl_path: str = ""
+    jsonl_max_bytes: int = 0
+    jsonl_keep: int = 3
     watchdog: str = "warn"
     device_sync_spans: bool = False
     monitor_bridge: bool = True
     ledger: LedgerConfig = field(default_factory=LedgerConfig)
     request_trace: RequestTraceConfig = field(default_factory=RequestTraceConfig)
+    timeseries: TimeSeriesConfig = field(default_factory=TimeSeriesConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
+    incidents: IncidentConfig = field(default_factory=IncidentConfig)
 
     def __post_init__(self):
         if isinstance(self.ledger, dict):
             self.ledger = _build(LedgerConfig, self.ledger)
         if isinstance(self.request_trace, dict):
             self.request_trace = _build(RequestTraceConfig, self.request_trace)
+        if isinstance(self.timeseries, dict):
+            self.timeseries = _build(TimeSeriesConfig, self.timeseries)
+        if isinstance(self.slo, dict):
+            self.slo = _build(SLOConfig, self.slo)
+        if isinstance(self.incidents, dict):
+            self.incidents = _build(IncidentConfig, self.incidents)
         if self.watchdog not in ("off", "warn", "raise"):
             raise DeepSpeedConfigError(
                 f"telemetry.watchdog must be off|warn|raise, got {self.watchdog!r}")
+        if self.jsonl_max_bytes < 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.jsonl_max_bytes must be >= 0, "
+                f"got {self.jsonl_max_bytes}")
+        if self.jsonl_keep < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.jsonl_keep must be >= 1, got {self.jsonl_keep}")
 
 
 @dataclass
@@ -914,6 +1071,11 @@ class GatewayConfig:
     - ``shutdown_grace_s``: how long a SIGTERM drain waits for in-flight
       streams to finish before closing their connections anyway (0 =
       unbounded — trust the deadline machinery underneath).
+    - ``metrics_fleet_refresh_s``: serve-loop cadence for refreshing the
+      cached fleet telemetry snapshot that ``GET /metrics`` renders with
+      per-replica labels (the loop owns the RPC sockets; handler threads
+      only read the cache). 0 = off — ``/metrics`` exports the gateway's
+      local registry only.
     """
 
     enabled: bool = False
@@ -924,6 +1086,7 @@ class GatewayConfig:
     retry_after_s: float = 0.0
     max_body_bytes: int = 1 << 20
     shutdown_grace_s: float = 30.0
+    metrics_fleet_refresh_s: float = 0.0
 
     def __post_init__(self):
         if not 0 <= self.port <= 65535:
@@ -934,10 +1097,11 @@ class GatewayConfig:
                 f"serving.gateway.stream_poll_s must be > 0, "
                 f"got {self.stream_poll_s}")
         if self.write_timeout_s < 0 or self.retry_after_s < 0 \
-                or self.shutdown_grace_s < 0:
+                or self.shutdown_grace_s < 0 \
+                or self.metrics_fleet_refresh_s < 0:
             raise DeepSpeedConfigError(
                 "serving.gateway write_timeout_s/retry_after_s/"
-                "shutdown_grace_s must be >= 0")
+                "shutdown_grace_s/metrics_fleet_refresh_s must be >= 0")
         if self.max_body_bytes < 1:
             raise DeepSpeedConfigError(
                 f"serving.gateway.max_body_bytes must be >= 1, "
@@ -1083,6 +1247,11 @@ class ServingConfig:
     # telemetry.request_trace — the serving engine owns its own Telemetry)
     ledger: LedgerConfig = field(default_factory=LedgerConfig)
     request_trace: RequestTraceConfig = field(default_factory=RequestTraceConfig)
+    timeseries: TimeSeriesConfig = field(default_factory=TimeSeriesConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
+    incidents: IncidentConfig = field(default_factory=IncidentConfig)
+    jsonl_max_bytes: int = 0
+    jsonl_keep: int = 3
 
     def __post_init__(self):
         if isinstance(self.prefix_cache, dict):
@@ -1101,6 +1270,19 @@ class ServingConfig:
             self.ledger = _build(LedgerConfig, self.ledger)
         if isinstance(self.request_trace, dict):
             self.request_trace = _build(RequestTraceConfig, self.request_trace)
+        if isinstance(self.timeseries, dict):
+            self.timeseries = _build(TimeSeriesConfig, self.timeseries)
+        if isinstance(self.slo, dict):
+            self.slo = _build(SLOConfig, self.slo)
+        if isinstance(self.incidents, dict):
+            self.incidents = _build(IncidentConfig, self.incidents)
+        if self.jsonl_max_bytes < 0:
+            raise DeepSpeedConfigError(
+                f"serving.jsonl_max_bytes must be >= 0, "
+                f"got {self.jsonl_max_bytes}")
+        if self.jsonl_keep < 1:
+            raise DeepSpeedConfigError(
+                f"serving.jsonl_keep must be >= 1, got {self.jsonl_keep}")
         if self.watchdog_mode not in ("off", "warn", "raise"):
             raise DeepSpeedConfigError(
                 f"serving.watchdog_mode must be off|warn|raise, "

@@ -27,11 +27,15 @@ instance each. Metric names follow ``subsystem/name``
 from .collective_ledger import (CollectiveLedger, parse_hlo_collectives,
                                 pipeline_bubble_fraction, step_anatomy,
                                 summarize_collectives)
-from .exporters import JsonlExporter, MonitorBridge, prometheus_text
+from .exporters import (JsonlExporter, MonitorBridge, prometheus_fleet_text,
+                        prometheus_text)
+from .incident import IncidentRecorder
 from .program_ledger import (ProgramLedger, aot_cost, hbm_snapshot,
                              platform_peaks, tree_bytes)
 from .registry import Counter, Gauge, Histogram, MetricsRegistry, get_registry
 from .request_trace import RequestTracer, request_timeline, to_perfetto
+from .slo import SLOTracker, classify_terminal
+from .timeseries import TimeSeriesStore
 from .tracing import Span, SpanTracer
 from .watchdog import RecompileError, RecompileWatchdog, abstract_signature
 
@@ -39,10 +43,12 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "Span", "SpanTracer", "RecompileError", "RecompileWatchdog",
     "abstract_signature", "JsonlExporter", "MonitorBridge", "prometheus_text",
-    "ProgramLedger", "aot_cost", "hbm_snapshot", "platform_peaks",
-    "tree_bytes", "RequestTracer", "request_timeline", "to_perfetto",
-    "CollectiveLedger", "parse_hlo_collectives", "summarize_collectives",
-    "step_anatomy", "pipeline_bubble_fraction", "Telemetry",
+    "prometheus_fleet_text", "ProgramLedger", "aot_cost", "hbm_snapshot",
+    "platform_peaks", "tree_bytes", "RequestTracer", "request_timeline",
+    "to_perfetto", "CollectiveLedger", "parse_hlo_collectives",
+    "summarize_collectives", "step_anatomy", "pipeline_bubble_fraction",
+    "Telemetry", "TimeSeriesStore", "SLOTracker", "classify_terminal",
+    "IncidentRecorder",
 ]
 
 
@@ -59,9 +65,11 @@ class Telemetry:
     def __init__(self, registry: MetricsRegistry | None = None,
                  jsonl_path: str = "", watchdog_mode: str = "warn",
                  device_sync_spans: bool = False, ledger: bool = True,
-                 ledger_collectives: bool = True, ici_gbps: float = 0.0):
+                 ledger_collectives: bool = True, ici_gbps: float = 0.0,
+                 jsonl_max_bytes: int = 0, jsonl_keep: int = 3):
         self.registry = registry if registry is not None else MetricsRegistry()
-        self.sink = JsonlExporter(jsonl_path) if jsonl_path else None
+        self.sink = JsonlExporter(jsonl_path, max_bytes=jsonl_max_bytes,
+                                  keep=jsonl_keep) if jsonl_path else None
         self.tracer = SpanTracer(self.registry, self.sink,
                                  device_sync=device_sync_spans)
         self.ledger = ProgramLedger(self.registry, enabled=ledger,

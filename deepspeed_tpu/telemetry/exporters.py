@@ -17,10 +17,12 @@ Three consumers, three shapes:
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Optional
 
+from ..utils.durability import fsync_dir
 from .registry import MetricsRegistry
 
 
@@ -31,13 +33,22 @@ class JsonlExporter:
     Writes are locked (spans may close from helper threads) and flushed per
     emit — event rates here are per-step/per-request, not per-token, so
     durability beats batching.
+
+    ``max_bytes > 0`` bounds the file on long-running fleets: when an
+    append would grow past it, the live file rename-rotates to ``.1``
+    (existing rotations shift up, ``keep`` retained, oldest deleted) and a
+    fresh file opens — ``os.replace`` + directory fsync, so a crash
+    mid-rotation never loses the renamed history.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: int = 0, keep: int = 3):
         import weakref
 
         self.path = path
+        self.max_bytes = int(max_bytes)
+        self.keep = max(1, int(keep))
         self._f = open(path, "a")
+        self._bytes = os.fstat(self._f.fileno()).st_size
         self._lock = threading.Lock()
         # engines have no destroy() hook; a weakref finalizer closes the fd
         # at GC or interpreter exit WITHOUT pinning the exporter alive the
@@ -49,13 +60,45 @@ class JsonlExporter:
         if not f.closed:
             f.close()
 
+    def _rotate(self) -> None:
+        """Shift ``path.(keep-1)`` .. ``path.1`` up one, move the live file
+        to ``.1``, reopen fresh. Caller holds the lock; pure renames —
+        nothing here blocks on more than directory metadata."""
+        self._f.close()
+        try:
+            oldest = f"{self.path}.{self.keep}"
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(self.keep - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+            fsync_dir(self.path)
+        except OSError:
+            pass  # a failed rotation degrades to an unbounded file, not a crash
+        self._finalizer.detach()
+        self._f = open(self.path, "a")
+        self._bytes = os.fstat(self._f.fileno()).st_size
+        import weakref
+
+        self._finalizer = weakref.finalize(
+            self, JsonlExporter._close_file, self._f)
+
     def emit(self, event: dict) -> None:
         # dstpu: allow[wall-clock-verdict] -- JSONL event timestamps are cross-run/cross-host wall-clock BY DESIGN (report tooling correlates logs from different processes); they are never subtracted against a deadline or staleness bound
         line = json.dumps({"t": time.time(), **event}, separators=(",", ":"),
                           default=str)
+        data = line + "\n"
         with self._lock:
-            self._f.write(line + "\n")
+            if self._f.closed:
+                return
+            if (self.max_bytes > 0 and self._bytes > 0
+                    and self._bytes + len(data) > self.max_bytes):
+                self._rotate()
+            self._f.write(data)
             self._f.flush()
+            self._bytes += len(data)
 
     def close(self) -> None:
         with self._lock:
@@ -69,25 +112,66 @@ def _prom_name(name: str) -> str:
     return "dstpu_" + "".join(out)
 
 
-def prometheus_text(registry: MetricsRegistry) -> str:
-    """Prometheus text exposition of a registry snapshot."""
-    snap = registry.snapshot()
+def _prom_lines(snap: dict, labels: str = "",
+                seen: Optional[set] = None) -> list:
+    """Exposition lines for one registry snapshot. ``labels`` is a
+    pre-rendered label body (e.g. ``replica="0"``); ``seen`` dedupes the
+    ``# HELP``/``# TYPE`` headers across the fleet's nested snapshots —
+    Prometheus drops an exposition that repeats metadata for a family."""
+    seen = set() if seen is None else seen
+    lab = "{" + labels + "}" if labels else ""
     lines = []
+
+    def head(pn: str, kind: str, src: str) -> None:
+        if pn in seen:
+            return
+        seen.add(pn)
+        lines.append(f"# HELP {pn} deepspeed_tpu metric {src}")
+        lines.append(f"# TYPE {pn} {kind}")
+
     for name, v in snap["counters"].items():
         pn = _prom_name(name)
-        lines.append(f"# TYPE {pn}_total counter")
-        lines.append(f"{pn}_total {v}")
+        head(f"{pn}_total", "counter", name)
+        lines.append(f"{pn}_total{lab} {v}")
     for name, v in snap["gauges"].items():
         pn = _prom_name(name)
-        lines.append(f"# TYPE {pn} gauge")
-        lines.append(f"{pn} {v}")
+        head(pn, "gauge", name)
+        lines.append(f"{pn}{lab} {v}")
     for name, h in snap["histograms"].items():
         pn = _prom_name(name)
-        lines.append(f"# TYPE {pn} summary")
+        head(pn, "summary", name)
         for q in ("p50", "p90", "p99"):
-            lines.append(f'{pn}{{quantile="0.{q[1:]}"}} {h[q]}')
-        lines.append(f"{pn}_sum {h['sum']}")
-        lines.append(f"{pn}_count {h['count']}")
+            qlab = "{" + (labels + "," if labels else "") \
+                + f'quantile="0.{q[1:]}"' + "}"
+            lines.append(f"{pn}{qlab} {h[q]}")
+        lines.append(f"{pn}_sum{lab} {h['sum']}")
+        lines.append(f"{pn}_count{lab} {h['count']}")
+    return lines
+
+
+def prometheus_text(registry: MetricsRegistry) -> str:
+    """Prometheus text exposition of a registry snapshot (with ``# HELP``/
+    ``# TYPE`` metadata per family)."""
+    return "\n".join(_prom_lines(registry.snapshot())) + "\n"
+
+
+def prometheus_fleet_text(snapshot: dict) -> str:
+    """Exposition of a ``Router.telemetry_snapshot()``: the router's own
+    registry unlabeled, each replica's registry under a
+    ``replica="<rid>"`` label — same metric family, distinct series, so a
+    scrape of the fleet neither collides nor drops replicas. Replica
+    blocks that carry no metrics (an unreachable replica's stub) are
+    skipped."""
+    seen: set = set()
+    lines = _prom_lines(snapshot.get("router", {}).get("metrics")
+                        or {"counters": {}, "gauges": {}, "histograms": {}},
+                        seen=seen)
+    for rid in sorted(snapshot.get("replicas") or {}):
+        metrics = (snapshot["replicas"][rid] or {}).get("metrics")
+        if not metrics:
+            continue
+        lines.extend(_prom_lines(metrics, labels=f'replica="{rid}"',
+                                 seen=seen))
     return "\n".join(lines) + "\n"
 
 

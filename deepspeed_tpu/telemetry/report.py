@@ -2,6 +2,7 @@
 
     python -m deepspeed_tpu.telemetry.report run.jsonl [--top 10]
         [--json] [--request UID] [--step-anatomy] [--perfetto out.json]
+        [--watch N]
 
 Pretty-prints, for CI logs and bench triage:
 
@@ -25,7 +26,15 @@ Pretty-prints, for CI logs and bench triage:
   * the serving-router table (per-replica health state and
     dispatched/failed-over/drained/completed counts plus the ``router/*``
     counters) when the snapshot came from a ``Router``,
+  * the flight-recorder tables (docs/observability.md "Flight recorder &
+    SLOs"): SLO attainment + multi-window burn rates with the fast-burn
+    breach flagged, the telemetry rings' last cells, and the incident
+    bundle index (inspect bundles with ``bin/dstpu_autopsy``),
   * the last registry ``snapshot`` event, if the run emitted one.
+
+``--watch N`` re-renders the summary every N seconds (ANSI screen clear
+between frames, ctrl-C exits) — live triage against a JSONL a serving
+fleet is still appending to.
 
 Query modes:
 
@@ -56,6 +65,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from collections import defaultdict
 
 from .request_trace import request_timeline, to_perfetto
@@ -432,6 +442,70 @@ def summarize(events: list[dict], top: int = 10) -> str:
             lines.append(f"  ... +{len(asc_events) - top} earlier events")
         lines.append("")
 
+    # -- slo attainment / burn rates -------------------------------------
+    # the tracker's last verdict (telemetry/slo.py, riding the router
+    # snapshot): attainment vs target per dimension plus the multi-window
+    # burn pair, with the fast-burn breach flagged loudly
+    slo = rt.get("slo") if rt else None
+    if slo:
+        head = (f"slo (window {_fmt_s(slo.get('window_s', 0.0))}, burn "
+                f"windows {_fmt_s(slo.get('fast_window_s', 0.0))}/"
+                f"{_fmt_s(slo.get('slow_window_s', 0.0))})")
+        if slo.get("breach"):
+            head += ("  <-- FAST-BURN BREACH: "
+                     + ",".join(slo.get("breach_dims", [])))
+        lines.append(head + ":")
+        lines.append(f"  {'dimension':<14} {'attainment':>10} {'target':>8} "
+                     f"{'burn fast':>10} {'burn slow':>10}")
+        att = slo.get("attainment", {})
+        burn = slo.get("burn", {})
+        targets = slo.get("targets", {})
+        for dim in ("ttft", "tpot", "availability"):
+            b = burn.get(dim, {})
+            lines.append(
+                f"  {dim:<14} {att.get(dim, 1.0):>10.4f} "
+                f"{targets.get(dim, 0.0):>8.4f} {b.get('fast', 0.0):>10.2f} "
+                f"{b.get('slow', 0.0):>10.2f}")
+        lines.append("")
+
+    # -- flight-recorder rings -------------------------------------------
+    # one line per series: last raw cell + coverage, so "was the fleet
+    # sampling" and "what did queue depth look like" answer from CI logs
+    rings = rt.get("rings") if rt else None
+    if rings:
+        srcs = [("router", rings.get("router", {}))]
+        srcs += sorted((f"replica {rid}", s)
+                       for rid, s in (rings.get("replicas") or {}).items())
+        n_series = sum(len(s.get("series", {})) for _, s in srcs)
+        lines.append(f"flight recorder rings ({n_series} series):")
+        for label, store in srcs:
+            for name, tiers in sorted(store.get("series", {}).items()):
+                raw = None
+                for cells in tiers.values():
+                    if cells:
+                        raw = cells[-1] if raw is None or \
+                            cells[-1][0] > raw[0] else raw
+                if raw is None:
+                    continue
+                t, lo, hi, s, n = raw
+                lines.append(
+                    f"  {label:<11} {name:<34} last@{_fmt_s(t):>9} "
+                    f"min={lo:g} max={hi:g} sum={s:g} n={int(n)}")
+        lines.append("")
+
+    # -- incident bundles ------------------------------------------------
+    incs = rt.get("incidents") if rt else None
+    if incs:
+        lines.append(f"incident bundles ({len(incs)}, newest first — "
+                     "inspect with bin/dstpu_autopsy):")
+        for b in incs[:top]:
+            lines.append(f"  #{b.get('seq', 0):>4} {b.get('kind', '?'):<18} "
+                         f"{_fmt_qty(b.get('bytes'), 'B'):>10}  "
+                         f"{b.get('file', '')}")
+        if len(incs) > top:
+            lines.append(f"  ... +{len(incs) - top} older bundles")
+        lines.append("")
+
     # -- resilience -----------------------------------------------------
     # recovery/degradation events (resilience/* counters) + injector stats,
     # rendered as their own table so a faulted run's triage starts here
@@ -567,12 +641,42 @@ def format_timeline(timeline: list[dict]) -> str:
     return "\n".join(lines) + "\n"
 
 
+_CLEAR = "\x1b[2J\x1b[H"  # ANSI: clear screen + cursor home
+
+
+def watch_loop(render, interval_s: float, *, out=None, sleep=None,
+               iterations=None) -> int:
+    """``--watch`` driver: clear the screen and re-render every
+    ``interval_s`` seconds until interrupted. ``render()`` returns the
+    full text per frame (re-reading the JSONL — the file grows under us).
+    ``out``/``sleep``/``iterations`` are injectable for tests (a fake
+    clock and a frame budget make this host-only testable)."""
+    out = out if out is not None else sys.stdout
+    sleep = sleep if sleep is not None else time.sleep
+    frames = 0
+    try:
+        while iterations is None or frames < iterations:
+            out.write(_CLEAR)
+            out.write(render())
+            out.flush()
+            frames += 1
+            if iterations is not None and frames >= iterations:
+                break
+            sleep(interval_s)
+    except KeyboardInterrupt:
+        pass  # ctrl-C ends the watch cleanly, not with a traceback
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deepspeed_tpu.telemetry.report",
         description="Pretty-print a telemetry JSONL run summary.")
     ap.add_argument("jsonl", help="path to the telemetry JSONL event log")
     ap.add_argument("--top", type=int, default=10, help="span rows to show")
+    ap.add_argument("--watch", type=float, default=None, metavar="N",
+                    help="re-render the summary every N seconds (screen "
+                         "clears between frames; ctrl-C exits)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output: {snapshot, roofline, "
                          "hbm, requests[, request_timeline]}")
@@ -586,6 +690,12 @@ def main(argv=None) -> int:
                     help="write the last snapshot's request timelines as "
                          "Chrome-trace JSON (ui.perfetto.dev)")
     args = ap.parse_args(argv)
+    if args.watch is not None:
+        if args.watch <= 0:
+            ap.error("--watch interval must be > 0 seconds")
+        return watch_loop(
+            lambda: summarize(load_events(args.jsonl), top=args.top),
+            args.watch)
     events = load_events(args.jsonl)
     snap = last_snapshot(events)
 

@@ -99,6 +99,10 @@ class RecompileWatchdog:
         self.ledger = ledger
         self.events: list[dict] = []  # chronological compile events
         self._watched: dict[str, dict] = {}  # name -> {stable, compiles}
+        # optional incident hook: called as on_refusal(name, signature) on
+        # each FIRST refusal of a stable path (the serving engine points
+        # this at its IncidentRecorder — telemetry/incident.py)
+        self.on_refusal = None
 
     # -- bookkeeping ----------------------------------------------------
 
@@ -139,6 +143,8 @@ class RecompileWatchdog:
             self.events.append(ev)
             if self.sink is not None:
                 self.sink.emit(ev)
+            if self.on_refusal is not None:
+                self.on_refusal(name, signature)
 
     def _violation(self, name: str, ev: dict) -> None:
         msg = (

@@ -71,6 +71,39 @@ def pytest_sessionfinish(session, exitstatus):
     except OSError:
         return  # read-only checkout: the ledger is best-effort
 
+    # Incident-bundle quiescence verdict (docs/observability.md "Flight
+    # recorder & SLOs"): a clean run must write ZERO unexpected incident
+    # bundles under the test workdirs. Tests that create bundles ON
+    # PURPOSE (trigger-matrix tests) drop a `.expected-incidents` marker
+    # file beside them to opt out. Runs on every session — staging an
+    # incident costs one trigger call, so even a narrow run can leak one.
+    try:
+        base = str(session.config._tmp_path_factory.getbasetemp())
+        leaked = []
+        for dirpath, _dirnames, filenames in os.walk(base):
+            if any(f.startswith("incident-") and f.endswith(".json")
+                   for f in filenames):
+                marked = False
+                probe = dirpath
+                while probe.startswith(base):
+                    if os.path.exists(os.path.join(probe,
+                                                   ".expected-incidents")):
+                        marked = True
+                        break
+                    probe = os.path.dirname(probe)
+                if not marked:
+                    leaked.extend(os.path.join(dirpath, f)
+                                  for f in filenames
+                                  if f.startswith("incident-")
+                                  and f.endswith(".json"))
+        if leaked:
+            print(f"\n-- incident bundles: {len(leaked)} UNEXPECTED under "
+                  f"{base} (expected 0) — first: {leaked[0]} --")
+        else:
+            print("\n-- incident bundles: 0 unexpected (quiescent) --")
+    except Exception as e:  # noqa: BLE001 — advisory only, never fails a run
+        print(f"\n[conftest] incident-bundle verdict skipped: {e}")
+
     # Warn-only budget verdict on every FULL warm run: project the fresh
     # ledger against the tier-1 ceiling so the drift band PRs 5-6 fought is
     # visible at the end of each session instead of surfacing as a driver
