@@ -705,6 +705,226 @@ def _chaos_serving(seed: int) -> int:
         sup.shutdown()
 
 
+def _disagg_drill(seed: int) -> int:
+    """Disaggregated prefill/decode drill (``bench.py --disagg``): the
+    role-split fleet's headline proof, three phases —
+
+      1. IN-PROCESS parity matrix: a 2-prefill + 2-decode fleet vs the
+         co-located single-replica fleet, across the chunked-prefill +
+         prefix-cache matrix with and without speculation. Every greedy
+         stream must be BITWISE identical; the tokens/sec ratio vs the
+         co-located run is measured and reported (never gated — CPU).
+      2. PER-POOL autoscaling: an arrival burst must draw at least one
+         scale decision in EACH pool (prefill on queue/backlog, decode on
+         occupancy/parked handoffs), and both pools must return to their
+         floors after the burst.
+      3. MID-HANDOFF SIGKILL over REAL worker processes: two prefill-role
+         + one decode-role workers; the prefill worker streaming the
+         second KV handoff is SIGKILL'd between export windows. Zero
+         accepted-request loss, bitwise parity with the co-located
+         reference, exactly-once failover, and the dead verdict on the
+         corpse are all asserted.
+
+    Emits one JSON row with handoff p50/p99, per-pool replica counts and
+    scale decisions, and the tokens/sec ratio — flat ``disagg_*`` keys the
+    trajectory tooling delta-tracks (non-gating). CPU-pinned correctness
+    soak, never a perf datapoint."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests", ".xla_cache"))
+    import signal
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.inference import InferenceEngine, Router
+    from deepspeed_tpu.inference.serving import Request, ServingEngine
+    from deepspeed_tpu.launcher.serving_worker import WorkerSupervisor
+    from deepspeed_tpu.models.transformer import Model, TransformerConfig
+
+    t0 = time.perf_counter()
+    serving_cfg = {
+        "n_slots": 2, "max_seq_len": 128, "watchdog_mode": "raise",
+        "chunked_prefill": {"enabled": True, "chunk_size": 16},
+        "prefix_cache": {"enabled": True, "n_slots": 4, "block": 8,
+                         "max_prefix_len": 64, "insert_policy": "always"},
+    }
+    model_spec = {"vocab_size": 97, "max_seq_len": 128, "num_layers": 2,
+                  "num_heads": 4, "hidden_size": 32, "dtype": "float32",
+                  "loss_chunk_size": 0, "decode_attn": "xla",
+                  "pos_emb": "rotary"}
+    cfg = TransformerConfig(**{**model_spec, "dtype": jnp.float32})
+    eng = InferenceEngine(model=Model(cfg), config={"dtype": "fp32"})
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, 97, size=int(n)).astype(np.int32)
+               for n in rng.integers(8, 42, size=6)]
+
+    def mk(uid, i, max_new=12):
+        return Request(uid=uid, prompt=prompts[i], max_new_tokens=max_new)
+
+    # -- phase 1: in-process parity matrix + tokens/sec ratio -------------
+    legs = {"base": {}, "speculation": {
+        "speculation": {"enabled": True, "depth": 4, "ngram_min_match": 2}}}
+    ratio = None
+    for leg, extra in legs.items():
+        base = Router(eng, config={**serving_cfg, **extra}, replicas=1)
+        for i in range(6):
+            base.submit(mk(i, i))
+        t_base = time.perf_counter()
+        ref = base.drain()
+        t_base = time.perf_counter() - t_base
+        dis = Router(eng, config={
+            **serving_cfg, **extra,
+            "router": {"disagg": {"enabled": True, "prefill_replicas": 2,
+                                  "decode_replicas": 2}}})
+        for i in range(6):
+            dis.submit(mk(i, i))
+        t_dis = time.perf_counter()
+        out = dis.drain()
+        t_dis = time.perf_counter() - t_dis
+        for i in range(6):
+            assert ref[i].ok and out[i].ok, (leg, i, out[i].status)
+            np.testing.assert_array_equal(
+                ref[i].tokens, out[i].tokens,
+                err_msg=f"leg {leg}: uid {i} diverged across the handoff")
+        st = dis.router_stats()
+        assert st["disagg"]["handoffs"] == 6, (leg, st["disagg"])
+        if leg == "base":
+            # same tokens both runs, so the ratio is pure wall-clock
+            ratio = round(t_base / t_dis, 3)
+
+    # -- phase 2: per-pool autoscaling over an arrival burst --------------
+    asc_router = Router(eng, config={
+        **serving_cfg,
+        "router": {
+            "disagg": {"enabled": True, "prefill_replicas": 1,
+                       "decode_replicas": 1, "prefill_max_replicas": 2,
+                       "decode_max_replicas": 2, "prefill_scale_up_queue": 3,
+                       "prefill_scale_up_backlog": 3,
+                       "decode_scale_up_occupancy": 0.75},
+            "autoscale": {"enabled": True, "min_replicas": 1,
+                          "max_replicas": 4, "up_consecutive": 2,
+                          "down_consecutive": 2, "cooldown_s": 0.0}}})
+    for i in range(8):
+        asc_router.submit(Request(
+            uid=i, prompt=rng.integers(1, 97, size=20 + i).astype(np.int32),
+            max_new_tokens=16))
+    t = 0.0
+    while asc_router._owner:
+        t += 1.0
+        asc_router.step(now=t, enforce_deadlines=False)
+    for _ in range(30):
+        t += 1.0
+        asc_router.step(now=t)
+    assert all(r.ok for r in asc_router.results.values())
+    asc = asc_router._autoscaler.describe()
+    decisions = {"prefill": 0, "decode": 0}
+    for e in asc["events"]:
+        if (e["kind"] in ("scale_up", "scale_up_started", "scale_down")
+                and e.get("pool") in decisions):
+            decisions[e["pool"]] += 1
+    assert decisions["prefill"] >= 1, asc["events"]
+    assert decisions["decode"] >= 1, asc["events"]
+    assert all(p["target"] == 1 for p in asc["pools"].values()), asc["pools"]
+
+    # -- phase 3: mid-handoff SIGKILL over real worker processes ----------
+    spec = {"model": model_spec, "engine_dtype": "fp32",
+            "serving": serving_cfg}
+    ref_srv = ServingEngine(eng, config=serving_cfg)
+    for i in range(6):
+        ref_srv.submit(mk(100 + i, i))
+    ref = {u: r.tokens for u, r in ref_srv.drain().items()}
+
+    sup = WorkerSupervisor(
+        spec, 3,
+        transport={"call_timeout_s": 120.0, "boot_timeout_s": 300.0,
+                   "heartbeat_timeout_s": 30.0, "base_delay_s": 0.05,
+                   "max_delay_s": 0.2, "jitter": 0.0},
+        roles={0: "prefill", 1: "prefill", 2: "decode"},
+        seed=seed)
+    try:
+        clients = sup.start()
+        router = Router(
+            config={"router": {"replicas": 3, "health": {"timeout": 60.0},
+                               "disagg": {"enabled": True}}},
+            replica_engines=clients)
+
+        # arm the mid-handoff kill: the SECOND KV window export anywhere in
+        # the fleet SIGKILLs its own worker first, so the stream dies with
+        # the process BETWEEN import_begin and the window landing — the
+        # exact failure site the handoff state machine must replay across
+        kill_state = {"exports": 0, "victim": None}
+
+        def _arm(slot, client):
+            orig = client.kv_export_window
+
+            def _export(uid, start, width, compression="none"):
+                kill_state["exports"] += 1
+                if kill_state["exports"] == 2 and kill_state["victim"] is None:
+                    kill_state["victim"] = slot
+                    os.kill(sup.proc(slot).pid, signal.SIGKILL)
+                    sup.proc(slot).wait(timeout=30)
+                return orig(uid, start, width, compression=compression)
+
+            client.kv_export_window = _export
+
+        for slot in (0, 1):
+            _arm(slot, clients[slot])
+
+        for i in range(6):
+            router.submit(mk(100 + i, i))
+        for _ in range(600):
+            router.step(now=0.0)
+            if all(100 + i in router.results for i in range(6)):
+                break
+        missing = [100 + i for i in range(6)
+                   if 100 + i not in router.results]
+        assert not missing, f"accepted requests lost: {missing}"
+        bad = {u: router.results[u].status for u in ref
+               if not router.results[u].ok}
+        assert not bad, f"non-ok terminals: {bad}"
+        for u in ref:
+            np.testing.assert_array_equal(
+                router.results[u].tokens, ref[u],
+                err_msg=f"uid {u} diverged after the mid-handoff kill")
+        assert kill_state["victim"] is not None, "kill never fired"
+        victim_rid = kill_state["victim"]  # slot == rid at boot
+        stats = router.router_stats()
+        assert router.replica_states()[victim_rid] == "dead"
+        assert stats["failovers_recovered"] >= 1, stats
+        assert stats["disagg"]["handoffs"] == 6, stats["disagg"]
+        hist = router.telemetry.registry.snapshot()["histograms"]
+        handoff_sec = hist.get("router/disagg/handoff_sec", {})
+
+        from collections import Counter as _Counter
+
+        statuses = _Counter(r.status for r in router.results.values())
+        print(json.dumps({
+            "metric": "disaggregated prefill/decode drill "
+                      "(handoffs under mid-transfer kill)",
+            "value": int(stats["disagg"]["handoffs"]),
+            "unit": "handoffs",
+            **_drill_stamp(),
+            "workers": {"prefill": 2, "decode": 1},
+            "kill": {"victim_rid": victim_rid, "site": "kv_export_window#2"},
+            "n_requests": len(ref),
+            "statuses": dict(statuses),
+            "greedy_bitwise_match": True,
+            "failovers_recovered": int(stats["failovers_recovered"]),
+            "disagg_handoff_p50_sec": round(handoff_sec.get("p50", 0.0), 6),
+            "disagg_handoff_p99_sec": round(handoff_sec.get("p99", 0.0), 6),
+            "disagg_prefill_replicas": stats["disagg"]["prefill_replicas"],
+            "disagg_decode_replicas": stats["disagg"]["decode_replicas"],
+            "disagg_tokens_per_sec_vs_colocated_ratio": ratio,
+            "scale_decisions": decisions,
+            "seed": seed,
+            "elapsed_s": round(time.perf_counter() - t0, 2),
+        }), flush=True)
+        return 0
+    finally:
+        sup.shutdown()
+
+
 def _surge(n_requests: int, seed: int) -> int:
     """Trace-driven surge/failure drill (``bench.py --surge [n]``): the
     self-healing elastic fleet end-to-end. One REAL worker process behind
@@ -2091,6 +2311,23 @@ if __name__ == "__main__":
                   f"({e})", file=sys.stderr)
             sys.exit(2)
         sys.exit(_gateway_chaos(gw_seed))
+    if "--disagg" in sys.argv:
+        # usage-error exit 2 on malformed values (same contract as
+        # --chaos/--chaos-serving/--surge/--gateway-chaos)
+        try:
+            idx = sys.argv.index("--disagg")
+            if idx + 1 < len(sys.argv) and not sys.argv[idx + 1].startswith("--"):
+                raise ValueError(
+                    f"unexpected operand {sys.argv[idx + 1]!r} (the drill "
+                    "takes only --disagg-seed)")
+            dg_seed = 0
+            if "--disagg-seed" in sys.argv:
+                dg_seed = int(sys.argv[sys.argv.index("--disagg-seed") + 1])
+        except (IndexError, ValueError) as e:
+            print(f"usage: bench.py --disagg [--disagg-seed <int>] ({e})",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_disagg_drill(dg_seed))
     if "--chaos-serving" in sys.argv:
         # usage-error exit 2 on malformed values (same contract as --chaos)
         try:

@@ -111,6 +111,7 @@ class _Replica:
 
     rid: int
     engine: ServingEngine
+    role: str = "both"       # disagg pool membership: prefill|decode|both
     state: str = "healthy"
     hung_verdicts: int = 0
     readmit_at: float = 0.0  # router-clock time probation ends
@@ -172,6 +173,13 @@ class Router:
         self.health: RouterHealthConfig = rc.health
         self.affinity = bool(rc.affinity)
         self.max_queue_len = int(rc.max_queue_len)
+        # disaggregated prefill/decode serving (docs/serving.md
+        # "Disaggregated prefill/decode"): when enabled, dispatch targets
+        # the PREFILL pool only and _pump_handoffs streams finished
+        # prefills' slot-KV into the decode pool each step
+        self.disagg = rc.disagg
+        self._handoff_backlog = 0   # parked handoffs the last pump left
+        self._handoffs_done = 0     # committed prefill->decode transfers
 
         fi = config.get("fault_injection", {})
         if isinstance(fi, dict):
@@ -243,15 +251,38 @@ class Router:
                 # one clock across the fleet (a remote replica re-anchors
                 # its own perf_counter to the router's elapsed time)
                 e.set_epoch(self._epoch)
-                self._replicas.append(_Replica(rid, e))
+                # pool membership comes from the engine itself: a worker
+                # process was booted with --role (its ping reply carries
+                # it), an in-process engine with role=... A client that was
+                # never pinged still reports the default "both", which
+                # would silently collapse the pool split — so discover the
+                # role over the wire once, at fleet build (failure is fine:
+                # the health machine owns dead-at-boot replicas).
+                if rc.disagg.enabled and hasattr(e, "rpc"):
+                    try:
+                        e.ping()
+                    except (RpcError, OSError):
+                        pass
+                self._replicas.append(_Replica(
+                    rid, e, role=str(getattr(e, "role", "both") or "both")))
         else:
+            roles = ["both"] * rc.replicas
+            if rc.disagg.enabled:
+                # the pool split overrides the flat replica count: the
+                # fleet is prefill_replicas + decode_replicas engines over
+                # the same params/mesh, differing only in scheduler role
+                rc.replicas = (int(rc.disagg.prefill_replicas)
+                               + int(rc.disagg.decode_replicas))
+                roles = (["prefill"] * int(rc.disagg.prefill_replicas)
+                         + ["decode"] * int(rc.disagg.decode_replicas))
             for rid in range(rc.replicas):
-                e = ServingEngine(engine, config=sub, replica_id=rid)
+                e = ServingEngine(engine, config=sub, replica_id=rid,
+                                  role=roles[rid])
                 # one clock across the fleet: replica-relative timings
                 # (queue wait, TTFT) stay comparable and step(now=...) means
                 # the same instant on every replica
                 e.set_epoch(self._epoch)
-                self._replicas.append(_Replica(rid, e))
+                self._replicas.append(_Replica(rid, e, role=roles[rid]))
         self._owner: dict[int, int] = {}      # live uid -> replica id
         self._seen: dict[int, set] = {}       # uid -> replicas that held it
         self._failovers: dict[int, int] = {}  # uid -> failover count
@@ -337,8 +368,18 @@ class Router:
 
     # -- dispatch --------------------------------------------------------
 
-    def _accepting(self) -> list[_Replica]:
-        return [r for r in self._replicas if r.accepts]
+    def _accepting(self, role: str | None = None) -> list[_Replica]:
+        if role is None:
+            return [r for r in self._replicas if r.accepts]
+        return [r for r in self._replicas if r.accepts and r.role == role]
+
+    def _dispatch_targets(self) -> list[_Replica]:
+        """Replicas eligible for NEW request dispatch — and for failover
+        replays, which re-run admission+prefill from scratch: the prefill
+        pool under disaggregation, every healthy replica otherwise."""
+        if self.disagg.enabled:
+            return self._accepting("prefill")
+        return self._accepting()
 
     def _pick(self, candidates: list[_Replica], request: Request) -> _Replica:
         """Prefix-affinity first (longest stat-free trie match wins), then
@@ -376,7 +417,7 @@ class Router:
         priority does the arrival itself bounce — typed ``overloaded`` so
         clients know to back off rather than hammer a saturated fleet."""
         tm = self.telemetry
-        healthy = self._accepting()
+        healthy = self._dispatch_targets()
         if not healthy:
             tm.counter("router/shed").inc()
             raise RequestRejected(
@@ -440,7 +481,7 @@ class Router:
                 self._fail(target,
                            "hung" if isinstance(e, RpcTimeout) else "dead",
                            now, self._pending_terminal)
-                healthy = self._accepting()
+                healthy = self._dispatch_targets()
                 if not healthy:
                     tm.counter("router/shed").inc()
                     raise RequestRejected(
@@ -783,8 +824,8 @@ class Router:
         tm = self.telemetry
         n = self._failovers.get(req.uid, 0)
         seen = self._seen.setdefault(req.uid, set())
-        targets = [r for r in self._replicas
-                   if r.accepts and r.rid not in seen]
+        targets = [r for r in self._dispatch_targets()
+                   if r.rid not in seen]
         if n >= 1 or not targets:
             self._owner.pop(req.uid, None)
             self._seen.pop(req.uid, None)
@@ -894,11 +935,172 @@ class Router:
             self._failover(req, terminal, from_rid=r.rid)
         self._update_gauges()
 
+    # -- disaggregated prefill/decode handoff (docs/serving.md) ----------
+
+    def _pump_handoffs(self, now: float, terminal: list) -> None:
+        """Stream every parked finished prefill into a decode-pool slot:
+        per ready handoff, ``kv_import_begin`` on the least-loaded clean
+        decode replica, the slot-KV window chunk by chunk
+        (``disagg.handoff_chunk`` wide — the compiled export/import
+        programs' pow2 bucket), then commit + release. Ownership moves to
+        the decode replica ONLY at commit, so the PR 6/8 exactly-once
+        discipline covers the whole transfer window:
+
+          * prefill dead mid-transfer — the decode-side staging is
+            aborted and the prefill's dead/hung verdict replays its
+            requests (this one included) from scratch through the prefill
+            pool, exactly once.
+          * decode dead pre-commit — NOT a failover: the uid never moved,
+            the handoff stays parked and the next pump picks another
+            decode replica.
+          * decode dead post-commit — a normal failover; the replay
+            re-enters via the prefill pool, whose prefix cache still holds
+            the prompt's KV (commit released the prefill's copy cleanly,
+            so the replay may land back on the SAME prefill replica).
+
+        ``kv_import_begin`` rejecting with ``no_slot`` leaves the handoff
+        parked; the standing backlog is the decode pool's scale-up
+        signal."""
+        from .rpc import decode_kv_window, encode_kv_window, kv_window_nbytes
+
+        tm = self.telemetry
+        W = int(self.disagg.handoff_chunk)
+        comp = str(self.disagg.kv_compression)
+        backlog = 0
+        for pre in list(self._replicas):
+            if pre.role != "prefill" or not pre.stepped:
+                continue
+            try:
+                ready = pre.engine.handoff_ready()
+            except RpcError:
+                continue  # its verdict lands on its next step
+            prefill_down = False
+            for h in ready:
+                uid = int(h["uid"])
+                req = self._requests.get(uid)
+                if self._owner.get(uid) != pre.rid or req is None:
+                    # orphaned park (lost-reply submit) or already
+                    # terminal router-side: the deadline sweep frees it
+                    continue
+                decs = [d for d in self._accepting("decode")
+                        if d.rid not in self._seen.get(uid, set())]
+                if not decs:
+                    backlog += 1
+                    continue
+                dec = min(decs, key=lambda d: (d.engine.load, d.rid))
+                t0 = time.perf_counter()
+                if self.tracer is not None:
+                    self.tracer.record(uid, "kv_handoff_started",
+                                       from_replica=pre.rid,
+                                       to_replica=dec.rid)
+                try:
+                    dec.engine.kv_import_begin(
+                        req, int(h["pos"]), int(h["first"]),
+                        prefix_hit_tokens=int(h.get("prefix_hit_tokens", 0)),
+                        t_admit=float(h.get("t_admit", 0.0)),
+                        t_first=float(h.get("t_first", 0.0)))
+                except RequestRejected:
+                    # decode pool saturated: stays parked, feeds the
+                    # decode scale-up signal
+                    backlog += 1
+                    tm.counter("router/disagg/handoff_no_slot").inc()
+                    continue
+                except RpcError as e:
+                    self._fail(dec, "hung" if isinstance(e, RpcTimeout)
+                               else "dead", now, terminal)
+                    backlog += 1
+                    continue
+                pos = int(h["pos"])
+                wire_total = raw_total = 0
+                imported = True
+                for start in range(0, ((pos + W - 1) // W) * W, W):
+                    try:
+                        if hasattr(pre.engine, "rpc"):
+                            window = pre.engine.kv_export_window(
+                                uid, start, W, compression=comp)
+                        else:
+                            k, v = pre.engine.kv_export_window(uid, start, W)
+                            window = encode_kv_window(k, v, comp)
+                    except RpcError as e:
+                        # prefill died mid-transfer: abort the staging,
+                        # then the verdict replays its work from scratch
+                        try:
+                            dec.engine.kv_import_abort(uid)
+                        except RpcError:
+                            pass
+                        self._fail(pre, "hung" if isinstance(e, RpcTimeout)
+                                   else "dead", now, terminal)
+                        imported = False
+                        prefill_down = True
+                        break
+                    wire, raw = kv_window_nbytes(window)
+                    wire_total += wire
+                    raw_total += raw
+                    try:
+                        if hasattr(dec.engine, "rpc"):
+                            dec.engine.kv_import_window(uid, start, W, window)
+                        else:
+                            kk, vv = decode_kv_window(window)
+                            dec.engine.kv_import_window(uid, start, W, kk, vv)
+                    except RpcError as e:
+                        self._fail(dec, "hung" if isinstance(e, RpcTimeout)
+                                   else "dead", now, terminal)
+                        imported = False
+                        break
+                if prefill_down:
+                    break  # _fail(pre) already replayed its whole slate
+                if not imported:
+                    backlog += 1  # still parked; next pump retries
+                    continue
+                try:
+                    committed = dec.engine.kv_import_commit(uid)
+                except RpcError as e:
+                    self._fail(dec, "hung" if isinstance(e, RpcTimeout)
+                               else "dead", now, terminal)
+                    backlog += 1
+                    continue
+                if not committed:
+                    backlog += 1  # staging swept decode-side; retry later
+                    continue
+                try:
+                    pre.engine.handoff_release(uid)
+                except RpcError:
+                    pass  # verdict next step; the parked copy is an orphan
+                seen = self._seen.setdefault(uid, set())
+                # the prefill side released cleanly (no cancel, no stale
+                # result), so a later decode-death replay MAY legally land
+                # back on it — where its prefix pool still holds the KV
+                seen.discard(pre.rid)
+                seen.add(dec.rid)
+                self._owner[uid] = dec.rid
+                dec.dispatched += 1
+                self._handoffs_done += 1
+                dt = time.perf_counter() - t0
+                tm.counter("router/disagg/handoffs").inc()
+                tm.histogram("router/disagg/handoff_sec").observe(dt)
+                tm.histogram("router/disagg/handoff_bytes").observe(
+                    float(wire_total))
+                if raw_total > wire_total:
+                    tm.counter("router/disagg/kv_bytes_saved").inc(
+                        raw_total - wire_total)
+                if self.tracer is not None:
+                    self.tracer.record(uid, "kv_handoff_done",
+                                       from_replica=pre.rid,
+                                       to_replica=dec.rid,
+                                       bytes=int(wire_total))
+        self._handoff_backlog = backlog
+        tm.gauge("router/disagg/parked_handoffs").set(backlog)
+
     def _update_gauges(self) -> None:
         tm = self.telemetry
         tm.gauge("router/healthy_replicas").set(
             sum(1 for r in self._replicas if r.state == "healthy"))
         tm.gauge("router/live_requests").set(len(self._owner))
+        if self.disagg.enabled:
+            tm.gauge("router/disagg/prefill_replicas").set(
+                len(self._accepting("prefill")))
+            tm.gauge("router/disagg/decode_replicas").set(
+                len(self._accepting("decode")))
 
     def _mirror_trace(self, r: _Replica) -> None:
         """Mirror the replica's piggybacked request-trace flush into a
@@ -1136,6 +1338,10 @@ class Router:
                 log_dist(f"router: replica {r.rid} drained and detached",
                          ranks=[0])
                 self._update_gauges()
+        if self.disagg.enabled:
+            # after the fleet stepped: prefills that finished THIS step are
+            # parked and ready, decode slots that freed THIS step can admit
+            self._pump_handoffs(now, terminal)
         tm.gauge("router/queue_depth").set(
             sum(r.engine.queue_len for r in self._replicas if r.stepped))
         self._update_gauges()
@@ -1183,7 +1389,11 @@ class Router:
         r.state = "draining"
         self.telemetry.counter("router/drains").inc()
         self._update_gauges()
-        targets = self._accepting()
+        # under disaggregation queued work only exists on prefill replicas,
+        # and a migrated request must land in the SAME pool (a decode
+        # replica would never prefill it)
+        targets = (self._accepting(r.role) if self.disagg.enabled
+                   else self._accepting())
         if targets:
             for req in list(r.engine.live_requests()):
                 if self._owner.get(req.uid) != r.rid:
@@ -1284,17 +1494,19 @@ class Router:
 
     # -- fleet membership ------------------------------------------------
 
-    def _spawn_inprocess(self) -> ServingEngine:
+    def _spawn_inprocess(self, role: str | None = None) -> ServingEngine:
         """One more in-process replica from the constructor's engine +
         per-replica config — the autoscaler's default scale-up path for
         fleets built from ``Router(engine, config=...)``. Same model, same
-        config ⇒ same XLA program shapes (cache hits, not new programs)."""
+        config ⇒ same XLA program shapes (cache hits, not new programs).
+        ``role`` pins the newcomer to a disagg pool (per-pool scale-up)."""
         if self._base_engine is None:
             raise ValueError(
                 "this fleet was built from prebuilt replica_engines; give "
                 "the autoscaler a spawn callable or a WorkerSupervisor")
         return ServingEngine(self._base_engine, config=self._sub_config,
-                             replica_id=len(self._replicas))
+                             replica_id=len(self._replicas),
+                             role=role or "both")
 
     def attach_replica(self, engine) -> int:
         """Grow the fleet at runtime — the worker supervisor's respawn
@@ -1311,7 +1523,8 @@ class Router:
             # a streaming front door is attached: joiners piggyback
             # tokens-so-far like the rest of the fleet
             engine.stream_progress = True
-        self._replicas.append(_Replica(rid, engine))
+        self._replicas.append(_Replica(
+            rid, engine, role=str(getattr(engine, "role", "both") or "both")))
         self.telemetry.gauge("router/replicas").set(len(self._replicas))
         self.telemetry.counter("router/replicas_attached").inc()
         self._update_gauges()
@@ -1417,6 +1630,7 @@ class Router:
             "replicas": {
                 r.rid: {
                     "state": r.state,
+                    "role": r.role,
                     "dispatched": r.dispatched,
                     "failed_over": r.failed_over,
                     "drained": r.drained,
@@ -1426,6 +1640,13 @@ class Router:
                 } for r in self._replicas
             },
         }
+        if self.disagg.enabled:
+            out["disagg"] = {
+                "prefill_replicas": len(self._accepting("prefill")),
+                "decode_replicas": len(self._accepting("decode")),
+                "handoffs": self._handoffs_done,
+                "parked_backlog": self._handoff_backlog,
+            }
         if self._inj is not None:
             out["fault_injection"] = self._inj.stats()
         spec = self._spec_aggregate()

@@ -200,6 +200,66 @@ def decode_result(d: dict):
     return RequestResult(**d)
 
 
+# -- KV wire codec (disaggregated handoff) ----------------------------------
+#
+# The handoff streams slot-KV windows (serving.kv_export_window output,
+# [L, 1, width, H, Dh] per k/v) prefill -> decode. ``kv_compression="int8"``
+# (serving.router.disagg) applies the absmax discipline from
+# comm/compressed.py's int8 path — one fp32 scale per tensor, symmetric
+# round-to-nearest — quartering wire bytes at a documented tolerance cost
+# (docs/serving.md; bitwise greedy parity is only guaranteed with
+# compression OFF).
+
+def quantize_int8(a: np.ndarray) -> tuple[np.ndarray, float]:
+    """fp array -> (int8 array, scale) with symmetric absmax scaling."""
+    a = np.asarray(a)
+    scale = float(np.max(np.abs(a))) / 127.0 if a.size else 0.0
+    if scale == 0.0:
+        return np.zeros(a.shape, np.int8), 0.0
+    return np.clip(np.rint(a / scale), -127, 127).astype(np.int8), scale
+
+
+def dequantize_int8(q: np.ndarray, scale: float,
+                    dtype=np.float32) -> np.ndarray:
+    return (np.asarray(q, np.float32) * float(scale)).astype(dtype)
+
+
+def encode_kv_window(k: np.ndarray, v: np.ndarray,
+                     compression: str = "none") -> dict:
+    if compression == "int8":
+        qk, sk = quantize_int8(k)
+        qv, sv = quantize_int8(v)
+        return {"codec": "int8", "dtype": str(np.asarray(k).dtype),
+                "k": _enc_value(qk), "v": _enc_value(qv),
+                "k_scale": sk, "v_scale": sv}
+    return {"codec": "raw", "k": _enc_value(np.asarray(k)),
+            "v": _enc_value(np.asarray(v))}
+
+
+def decode_kv_window(d: dict) -> tuple[np.ndarray, np.ndarray]:
+    if d.get("codec") == "int8":
+        dt = np.dtype(d.get("dtype", "float32"))
+        return (dequantize_int8(_dec_value(d["k"]), d["k_scale"], dt),
+                dequantize_int8(_dec_value(d["v"]), d["v_scale"], dt))
+    return _dec_value(d["k"]), _dec_value(d["v"])
+
+
+def kv_window_nbytes(d: dict) -> tuple[int, int]:
+    """(wire_bytes, raw_bytes) of an encoded KV window: wire is the array
+    payload, raw is the uncompressed fp equivalent — their difference
+    feeds the bytes-saved counter. Handles both sides of the frame codec:
+    a freshly encoded window carries ``{"__nd__": b64}`` markers, one that
+    crossed the wire already holds decoded ndarrays."""
+    def _nbytes(x):
+        if isinstance(x, np.ndarray):
+            return x.nbytes
+        return (len(x["__nd__"]) * 3) // 4
+    wire = sum(_nbytes(d[key]) for key in ("k", "v"))
+    if d.get("codec") == "int8":
+        return wire, wire * np.dtype(d.get("dtype", "float32")).itemsize
+    return wire, wire
+
+
 # -- frame layer ------------------------------------------------------------
 
 def send_frame(sock: socket.socket, obj: Any) -> None:
@@ -602,10 +662,14 @@ class ReplicaClient:
                              fault_injection=fault_injection, seed=seed,
                              telemetry=telemetry)
         self.replica_id = replica_id
+        # serving role of the remote engine ("prefill"/"decode"/"both");
+        # refreshed from ping() — the Router's role-aware dispatch reads it
+        self.role = "both"
         self._load = 0
         self._idle = True
         self._queue_len = 0
         self._arrived = 0
+        self._occupancy = 0.0
         self._pending: list[float] = []
         self._compiled = False
         self._results: dict[int, object] = {}  # uid -> decoded RequestResult
@@ -624,6 +688,10 @@ class ReplicaClient:
         # Kept across a replica death so the fleet aggregate still counts
         # the dead worker's accepted tokens.
         self._spec: Optional[dict] = None
+        # prefill-role workers piggyback their parked prefill-complete
+        # requests ("handoff" on the step reply) so the Router's handoff
+        # pump needs zero extra polling round trips
+        self._handoff_ready: list[dict] = []
 
     # -- connection / identity ------------------------------------------
 
@@ -637,7 +705,10 @@ class ReplicaClient:
         self.rpc.close()
 
     def ping(self) -> dict:
-        return self.rpc.call("ping", retry_safe=True)
+        reply = self.rpc.call("ping", retry_safe=True)
+        if isinstance(reply, dict) and "role" in reply:
+            self.role = str(reply["role"])
+        return reply
 
     def rpc_stats(self) -> dict:
         return self.rpc.rpc_stats()
@@ -651,6 +722,8 @@ class ReplicaClient:
             self._queue_len = int(state["queue_len"])
         if "arrived" in state:
             self._arrived = int(state["arrived"])
+        if "occupancy" in state:
+            self._occupancy = float(state["occupancy"])
         if "pending" in state:
             self._pending = [float(t) for t in state["pending"]]
 
@@ -722,6 +795,8 @@ class ReplicaClient:
         self._progress = {int(k): [int(t) for t in v]
                           for k, v in (reply.get("progress") or {}).items()}
         self._spec = reply.get("spec") or self._spec
+        if "handoff" in reply:
+            self._handoff_ready = list(reply.get("handoff") or [])
         uids = [int(u) for u in reply.get("uids") or []]
         self._ack = list(uids)
         return uids
@@ -791,6 +866,12 @@ class ReplicaClient:
         return self._queue_len
 
     @property
+    def occupancy(self) -> float:
+        """Cached decode-slot occupancy from the last state piggyback —
+        the disagg autoscaler's decode-pool saturation signal."""
+        return self._occupancy
+
+    @property
     def last_step_compiled(self) -> bool:
         return self._compiled
 
@@ -829,6 +910,68 @@ class ReplicaClient:
             return None
         return np.asarray(toks, np.int32)
 
+    # -- disaggregated handoff surface -----------------------------------
+
+    def handoff_ready(self) -> list[dict]:
+        """Parked prefill-complete requests on this (prefill-role) worker,
+        from the step-piggybacked cache — NEVER the wire: the Router's
+        handoff pump polls this every step."""
+        return list(self._handoff_ready)
+
+    def kv_export_window(self, uid: int, start: int, width: int,
+                         compression: str = "none") -> dict:
+        """One chunk-granular slot-KV window, ENCODED (encode_kv_window):
+        the Router relays the dict straight into ``kv_import_window`` on a
+        decode worker with no host decode/re-encode in between. Replay-
+        safe: a pure read on the worker."""
+        return self.rpc.call(
+            "kv_export_window", uid=int(uid), start=int(start),
+            width=int(width), compression=str(compression), retry_safe=True)
+
+    def kv_import_window(self, uid: int, start: int, width: int,
+                         window: dict) -> None:
+        # replay-safe: re-importing the same window is an idempotent
+        # overwrite of the same cache region
+        reply = self.rpc.call(
+            "kv_import_window", uid=int(uid), start=int(start),
+            width=int(width), window=window, retry_safe=True)
+        self._refresh(reply)
+
+    def kv_import_begin(self, request, pos: int, first: int, *,
+                        prefix_hit_tokens: int = 0, t_admit: float = 0.0,
+                        t_first: float = 0.0) -> int:
+        # replay-safe: the worker treats a re-delivered staged uid as
+        # success (keyed staging, unlike submit's queue append). Raises
+        # RequestRejected(reason="no_slot") natively when the decode pool
+        # is full — the Router leaves the handoff parked.
+        reply = self.rpc.call(
+            "kv_import_begin", request=encode_request(request),
+            pos=int(pos), first=int(first),
+            prefix_hit_tokens=int(prefix_hit_tokens),
+            t_admit=float(t_admit), t_first=float(t_first), retry_safe=True)
+        self._refresh(reply)
+        return int(reply["slot"])
+
+    def kv_import_commit(self, uid: int) -> bool:
+        reply = self.rpc.call("kv_import_commit", uid=int(uid),
+                              retry_safe=True)
+        self._refresh(reply)
+        return bool(reply["committed"])
+
+    def kv_import_abort(self, uid: int) -> bool:
+        reply = self.rpc.call("kv_import_abort", uid=int(uid),
+                              retry_safe=True)
+        self._refresh(reply)
+        return bool(reply["aborted"])
+
+    def handoff_release(self, uid: int) -> bool:
+        reply = self.rpc.call("handoff_release", uid=int(uid),
+                              retry_safe=True)
+        self._refresh(reply)
+        self._handoff_ready = [h for h in self._handoff_ready
+                               if int(h.get("uid", -1)) != int(uid)]
+        return bool(reply["released"])
+
     # -- observability ---------------------------------------------------
 
     def spec_stats(self) -> Optional[dict]:
@@ -857,6 +1000,8 @@ __all__ = [
     "RpcError", "RpcTimeout", "RpcConnectionLost", "RpcGarbledFrame",
     "RpcRemoteError",
     "encode_request", "decode_request", "encode_result", "decode_result",
+    "encode_kv_window", "decode_kv_window", "kv_window_nbytes",
+    "quantize_int8", "dequantize_int8",
     "parse_address", "format_address",
     "recv_frame", "send_frame",
 ]

@@ -129,6 +129,14 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+# consecutive zero-acceptance verify steps before a slot's drafting is
+# suppressed outright (acceptance-aware speculation scheduling); each
+# further failed re-probe doubles the wait before the next one, capped at
+# 2^_SPEC_PROBE_WAIT_MAX_LOG2 decode steps
+_SPEC_SUPPRESS_AFTER = 3
+_SPEC_PROBE_WAIT_MAX_LOG2 = 6
+
+
 @dataclass
 class Request:
     """One generation request. ``arrival_time`` is seconds relative to the
@@ -214,6 +222,24 @@ class _Prefill:
     t_admit: float = 0.0  # epoch-relative admission time
 
 
+@dataclass
+class _Handoff:
+    """A prefill-role slot PARKED after admission: the prompt KV and first
+    token are resident, but a prefill worker never decodes — the slot waits
+    for the Router to stream its KV window into a decode replica
+    (``kv_export_window``) and release it (``handoff_release``). Occupied
+    (not in ``_free``), never ``_active``."""
+
+    req: Request
+    slot: int
+    first: int  # the sampled first token (travels with the handoff)
+    pos: int  # prompt length: KV resident in [0, pos)
+    prefix_hit_tokens: int
+    t_admit: float
+    t_first: float
+    entry: object = None  # acquired PrefixEntry, released on handoff_release
+
+
 class SlotWorker:
     """The compiled-program driver half of the serving engine.
 
@@ -291,6 +317,12 @@ class SlotWorker:
         self._fetch = None  # jitted prefix pool -> slot copy
         self._store = None  # jitted slot -> prefix pool copy
         self._poison = None  # jitted slot-KV fill (fault injection/scrub)
+        # disaggregated serving's KV wire programs (docs/serving.md
+        # "Disaggregated prefill/decode"): pow2 width -> jitted window
+        # slice / splat — the chunked-prefill width discipline applied to
+        # the handoff path, so the program set stays bounded
+        self._kv_exports: dict[int, object] = {}
+        self._kv_imports: dict[int, object] = {}
         self._decode_steps = 0
         # True if ANY dispatch since the scheduler last reset it paid a
         # compilation — the Router's step-latency heartbeat exempts such
@@ -474,6 +506,23 @@ class SlotWorker:
         return donated_jit(store, donate_argnums=(0,),
                            out_shardings=self._pool_shardings)
 
+    def _build_kv_export(self, width: int):
+        def export(cache, slot, start):
+            # pure read — the cache is NOT donated (it must survive the
+            # export; the prefill slot keeps serving retries until the
+            # router releases it). Returns the [L, 1, width, H, Dh] k/v
+            # window at [start, start+width) of row ``slot``.
+            return tfm.slice_cache_slot(cache, slot, width, start=start)
+
+        return donated_jit(export)
+
+    def _build_kv_import(self, width: int):
+        def imp(cache, new_kv, slot, start):
+            return tfm.update_cache_slot(cache, new_kv, slot, start=start)
+
+        return donated_jit(imp, donate_argnums=(0,),
+                           out_shardings=self._cache_shardings)
+
     def _chunk_prog(self, width: int):
         if width not in self._chunk_progs:
             wd = self.telemetry.watchdog
@@ -481,6 +530,22 @@ class SlotWorker:
                 self._build_chunk(width),
                 wd.unique_name(f"serving/chunk_prefill[{width}]"), stable=True)
         return self._chunk_progs[width]
+
+    def _kv_export_prog(self, width: int):
+        if width not in self._kv_exports:
+            wd = self.telemetry.watchdog
+            self._kv_exports[width] = wd.watch(
+                self._build_kv_export(width),
+                wd.unique_name(f"serving/kv_export[{width}]"), stable=True)
+        return self._kv_exports[width]
+
+    def _kv_import_prog(self, width: int):
+        if width not in self._kv_imports:
+            wd = self.telemetry.watchdog
+            self._kv_imports[width] = wd.watch(
+                self._build_kv_import(width),
+                wd.unique_name(f"serving/kv_import[{width}]"), stable=True)
+        return self._kv_imports[width]
 
     # -- dispatches ------------------------------------------------------
 
@@ -654,6 +719,32 @@ class SlotWorker:
             self._pool, self._cache, jnp.int32(slot), jnp.int32(pool_slot))
         self.step_compiled |= bool(self._store.last_call_compiled)
 
+    def kv_export(self, width: int, slot: int, start: int):
+        """Fetch one [start, start+width) KV window of ``slot`` to the host
+        — the disaggregated handoff's wire unit. Pow2 ``width`` keeps the
+        program family bounded (one program per width, slot/start traced).
+        Returns host ``(k, v)`` arrays [L, 1, width, H, Dh]."""
+        prog = self._kv_export_prog(width)
+        kv = prog(self._cache, jnp.int32(slot), jnp.int32(start))
+        self.step_compiled |= bool(prog.last_call_compiled)
+        self.telemetry.counter(f"serving/kv_export_bucket[{width}]").inc()
+        k, v = jax.device_get((kv["k"], kv["v"]))
+        return np.asarray(k), np.asarray(v)
+
+    def kv_import(self, width: int, k, v, slot: int, start: int) -> None:
+        """Splat one host KV window into [start, start+width) of ``slot``
+        — the import half of the handoff wire. Idempotent (a replayed
+        window writes the same bytes), donation + pinned output sharding
+        exactly like the chunk path, so the decode program's cache operand
+        never drifts."""
+        prog = self._kv_import_prog(width)
+        self._cache = prog(
+            self._cache,
+            {"k": jnp.asarray(k), "v": jnp.asarray(v)},
+            jnp.int32(slot), jnp.int32(start))
+        self.step_compiled |= bool(prog.last_call_compiled)
+        self.telemetry.counter(f"serving/kv_import_bucket[{width}]").inc()
+
     def fill_slot(self, slot: int, value: float) -> None:
         """Overwrite one slot's whole KV row with ``value`` — ONE compiled
         program (slot and value are traced operands), cache sharding pinned
@@ -721,6 +812,12 @@ class SlotWorker:
             out["prefix_fetch"] = int(self._fetch._cache_size())
         if self._store is not None:
             out["prefix_store"] = int(self._store._cache_size())
+        if self._kv_exports:
+            out["kv_export"] = {w: int(f._cache_size())
+                                for w, f in sorted(self._kv_exports.items())}
+        if self._kv_imports:
+            out["kv_import"] = {w: int(f._cache_size())
+                                for w, f in sorted(self._kv_imports.items())}
         if self._poison is not None:
             out["fill_slot"] = int(self._poison._cache_size())
         return out
@@ -794,10 +891,20 @@ class ServingEngine:
                  prefix_cache: PrefixCacheConfig | dict | None = None,
                  chunked_prefill: ChunkedPrefillConfig | dict | None = None,
                  speculation: SpeculationConfig | dict | None = None,
-                 fault_injection: FaultInjectionConfig | dict | None = None):
+                 fault_injection: FaultInjectionConfig | dict | None = None,
+                 role: str | None = None):
         config = dict(config or {})
         config.pop("router", None)  # the Router's block, not this engine's
         config.pop("gateway", None)  # the HTTP front door's block
+        # disaggregated serving role (docs/serving.md "Disaggregated
+        # prefill/decode"): ``both`` (the co-located default), ``prefill``
+        # (admission + chunked prefill, then park for KV handoff), or
+        # ``decode`` (receives handoffs via kv_import_*, owns decode/
+        # speculation/SSE progress). A Router or worker CLI assigns it.
+        self.role = role if role is not None else config.pop("role", "both")
+        if self.role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"serving role must be both|prefill|decode, got {self.role!r}")
         n_slots = n_slots if n_slots is not None else config.get("n_slots", 8)
         max_seq_len = max_seq_len if max_seq_len is not None else config.get(
             "max_seq_len", 0)
@@ -866,9 +973,11 @@ class ServingEngine:
         if isinstance(sp, dict):
             sp = SpeculationConfig(**sp)
         self.spec_cfg: SpeculationConfig = sp
-        # the drafter is constructed eagerly so a reserved draft_source
-        # fails at engine build, not on the first decode step
-        self._drafter = make_drafter(sp) if sp.enabled else None
+        # the drafter is constructed eagerly so a bad draft_source fails at
+        # engine build, not on the first decode step (draft_model needs the
+        # model's vocab size to build its host-resident scorer)
+        self._drafter = (make_drafter(sp, vocab_size=engine.cfg.vocab_size)
+                         if sp.enabled else None)
         # host-side acceptance bookkeeping (spec_stats / the step-reply
         # piggyback): plain ints — no registry read on the hot path
         self._spec_drafted = 0
@@ -882,6 +991,16 @@ class ServingEngine:
         # buckets (near decode-step cost) instead of paying the deepest
         # program for drafts that die at position 0
         self._spec_len = np.full((n_slots,), 2, np.int32)
+        # acceptance-aware suppression on top of AIMD: consecutive ZERO-
+        # acceptance verifies floor the slot's cap at 1, and past
+        # _SPEC_SUPPRESS_AFTER of them drafting stops entirely (cap 0 —
+        # the slot rides plain decode steps) with a decaying re-probe
+        # schedule, so a never-accepting request converges to decode-step
+        # dispatch rates instead of paying verify overhead forever
+        self._spec_zero_streak = np.zeros((n_slots,), np.int32)
+        self._spec_probe_wait = np.zeros((n_slots,), np.int32)
+        self._spec_suppressed_steps = 0
+        self._spec_probes = 0
 
         # -- degradation knobs (docs/resilience.md) ---------------------
         self.max_queue_len = int(config.get("max_queue_len", 0))
@@ -946,6 +1065,12 @@ class ServingEngine:
 
         self._queue: deque[Request] = deque()
         self._prefilling: dict[int, _Prefill] = {}  # slot -> admission state
+        # disaggregated-serving state (empty/ignored for role "both"):
+        # prefill role parks finished admissions here until the Router
+        # streams their KV out; decode role stages in-progress imports here
+        # until the Router commits them
+        self._handoffs: dict[int, _Handoff] = {}  # uid -> parked handoff
+        self._imports: dict[int, dict] = {}  # uid -> staged KV import
         self._rr = 0  # round-robin cursor over prefilling slots
         self._results: dict[int, RequestResult] = {}
         # quarantine bookkeeping: per-uid replay count, per-slot consecutive
@@ -1061,7 +1186,8 @@ class ServingEngine:
         # serve()'s completion count short — spinning forever
         live = ({r.uid for r in self._queue} | set(self._results)
                 | {s.uid for s in self._slots if s.uid >= 0}
-                | {p.req.uid for p in self._prefilling.values()})
+                | {p.req.uid for p in self._prefilling.values()}
+                | set(self._handoffs) | set(self._imports))
         if request.uid in live:
             raise ValueError(f"request uid {request.uid} is already in flight "
                              "or finished; uids must be unique per engine")
@@ -1124,6 +1250,159 @@ class ServingEngine:
                 return r
         return None
 
+    # -- disaggregated prefill/decode surface (docs/serving.md) ----------
+    #
+    # Prefill role: _activate parks finished admissions in self._handoffs;
+    # the Router discovers them (handoff_ready), streams their KV windows
+    # out (kv_export_window) and frees the slot once the decode side has
+    # committed (handoff_release). Decode role: the Router stages a slot
+    # (kv_import_begin), streams windows in (kv_import_window), then flips
+    # it to decoding (kv_import_commit) or unwinds (kv_import_abort).
+    # Every mutation is replay-tolerant — a retried RPC must not corrupt
+    # the handoff state machine.
+
+    def _check_kv_window(self, start: int, width: int) -> None:
+        if width < 1 or (width & (width - 1)) != 0 or width > 128:
+            raise ValueError(
+                f"kv window width must be a power of two <= 128, got {width}")
+        if start < 0 or start % width != 0 or start + width > self.Smax:
+            raise ValueError(
+                f"kv window [{start}, {start + width}) must be width-aligned "
+                f"inside the {self.Smax}-token slot cache")
+
+    def handoff_ready(self) -> list[dict]:
+        """Parked prefill-role handoffs awaiting KV transfer — the block a
+        worker process piggybacks on its step reply so the Router's handoff
+        pump discovers finished prefills with zero extra round trips."""
+        return [{"uid": int(uid), "pos": int(h.pos), "first": int(h.first),
+                 "prefix_hit_tokens": int(h.prefix_hit_tokens),
+                 "t_admit": float(h.t_admit), "t_first": float(h.t_first)}
+                for uid, h in self._handoffs.items()]
+
+    def kv_export_window(self, uid: int, start: int, width: int):
+        """One host KV window of a parked handoff's slot — a pure read
+        (replay-safe: a retried export returns the same bytes)."""
+        h = self._handoffs.get(int(uid))
+        if h is None:
+            raise ValueError(f"uid {uid} is not parked for handoff")
+        self._check_kv_window(start, width)
+        return self.worker.kv_export(width, h.slot, start)
+
+    def handoff_release(self, uid: int) -> bool:
+        """Free a parked handoff's slot after the decode side committed —
+        the request is MOVING, not terminal, so no result is synthesized
+        (the decode replica owns it from here). Replay-tolerant: releasing
+        an unknown uid is False, not an error."""
+        h = self._handoffs.pop(int(uid), None)
+        if h is None:
+            return False
+        if h.entry is not None:
+            self._pfx.release(h.entry)
+        # the slot's KV is finite (the prefill sentinel was checked before
+        # parking) — stale-but-finite KV is causally masked for the next
+        # occupant, the same contract every normal release relies on
+        self._free.append(h.slot)
+        self._exempt_uids.discard(int(uid))
+        self.telemetry.counter("serving/handoffs_released").inc()
+        if self.tracer is not None:
+            self.tracer.record(int(uid), "handoff_released", slot=h.slot)
+        return True
+
+    def kv_import_begin(self, request: Request, pos: int, first: int,
+                        prefix_hit_tokens: int = 0, t_admit: float = 0.0,
+                        t_first: float = 0.0) -> int:
+        """Stage a decode-role slot for an incoming KV handoff; returns the
+        slot. Raises a typed ``RequestRejected(reason="no_slot")`` when no
+        slot is free (the Router leaves the handoff parked and retries —
+        that backlog is the decode pool's scale-up signal). Replay-
+        tolerant: a uid already staged returns its existing slot."""
+        uid = int(request.uid)
+        if uid in self._imports:
+            return int(self._imports[uid]["slot"])
+        if not self._free:
+            raise RequestRejected(uid, "no_slot",
+                                  "no free decode slot for KV import")
+        if int(pos) + int(request.max_new_tokens) - 1 > self.budget:
+            raise ValueError(
+                f"kv import for uid {uid}: pos ({pos}) + remaining tokens "
+                f"exceed the slot budget {self.budget}")
+        slot = self._free.popleft()
+        self._imports[uid] = {
+            "slot": slot, "req": request, "pos": int(pos),
+            "first": int(first), "prefix_hit_tokens": int(prefix_hit_tokens),
+            "t_admit": float(t_admit), "t_first": float(t_first),
+        }
+        if self.tracer is not None:
+            self.tracer.record(uid, "kv_import_begin", slot=slot,
+                               pos=int(pos))
+        return slot
+
+    def kv_import_window(self, uid: int, start: int, width: int, k, v) -> None:
+        """Splat one streamed KV window into the staged slot. Idempotent —
+        a replayed window rewrites the same bytes."""
+        imp = self._imports.get(int(uid))
+        if imp is None:
+            raise ValueError(f"uid {uid} has no staged KV import")
+        self._check_kv_window(start, width)
+        self.worker.kv_import(width, k, v, imp["slot"], start)
+
+    def kv_import_commit(self, uid: int) -> bool:
+        """Flip a fully-streamed import to DECODING — the decode-role twin
+        of ``_activate``. Replay-tolerant: committing a uid that already
+        committed (active or terminal here) returns True; an unknown uid
+        returns False (the Router treats it as a lost handoff)."""
+        uid = int(uid)
+        imp = self._imports.pop(uid, None)
+        if imp is None:
+            return bool(uid in self._results
+                        or any(self._active[s] and self._slots[s].uid == uid
+                               for s in range(self.n_slots)))
+        slot, req = imp["slot"], imp["req"]
+        st = self._slots[slot]
+        st.uid = uid
+        st.remaining = req.max_new_tokens - 1
+        st.eos = req.eos_token if req.eos_token is not None else -1
+        st.tokens = [imp["first"]]
+        st.request = req
+        st.result = RequestResult(
+            uid=uid, tokens=np.zeros((0,), np.int32),
+            prompt_len=imp["pos"], arrival_time=req.arrival_time,
+            admitted_time=imp["t_admit"], first_token_time=imp["t_first"],
+            slot=slot, prefix_hit_tokens=imp["prefix_hit_tokens"],
+        )
+        self._active[slot] = True
+        self._pos[slot] = imp["pos"]
+        self._last_tok[slot] = imp["first"]
+        self._spec_len[slot] = 2
+        self._spec_zero_streak[slot] = 0
+        self._spec_probe_wait[slot] = 0
+        self._temp[slot] = req.temperature
+        self._top_k[slot] = req.top_k
+        self._top_p[slot] = req.top_p
+        if req.deadline_s > 0 or self.default_deadline_s > 0:
+            self._deadlines_armed = True
+        self.telemetry.counter("serving/kv_imports_committed").inc()
+        if self.tracer is not None:
+            self.tracer.record(uid, "kv_import_commit", slot=slot)
+        if imp["first"] == st.eos or st.remaining <= 0:
+            self._finish(slot)
+        return True
+
+    def kv_import_abort(self, uid: int) -> bool:
+        """Unwind a staged import (decode replica lost mid-stream, prefill
+        side failed over): free the slot, forget the staging. The partial
+        KV is finite garbage the next occupant's prefill masks/overwrites —
+        same contract as every slot release. Replay-tolerant."""
+        imp = self._imports.pop(int(uid), None)
+        if imp is None:
+            return False
+        self._free.append(imp["slot"])
+        self.telemetry.counter("serving/kv_imports_aborted").inc()
+        if self.tracer is not None:
+            self.tracer.record(int(uid), "kv_import_abort",
+                               slot=imp["slot"])
+        return True
+
     def result(self, uid: int) -> Optional[RequestResult]:
         """The terminal result for ``uid``, or None while in flight."""
         return self._results.get(uid)
@@ -1142,9 +1421,13 @@ class ServingEngine:
             st = self._slots[slot]
             if self._active[slot] and st.uid == uid:
                 return np.asarray(st.tokens, np.int32)
+        h = self._handoffs.get(uid)
+        if h is not None:
+            return np.asarray([h.first], np.int32)
         if (any(r.uid == uid for r in self._queue)
                 or any(pf.req.uid == uid
-                       for pf in self._prefilling.values())):
+                       for pf in self._prefilling.values())
+                or uid in self._imports):
             return np.zeros((0,), np.int32)
         return None
 
@@ -1163,6 +1446,9 @@ class ServingEngine:
         when this replica is declared dead or hung."""
         out = list(self._queue)
         out.extend(pf.req for _, pf in sorted(self._prefilling.items()))
+        # parked handoffs are accepted and non-terminal: a dead prefill
+        # replica's Router failover must replay them from scratch
+        out.extend(h.req for _, h in sorted(self._handoffs.items()))
         out.extend(st.request for slot, st in enumerate(self._slots)
                    if self._active[slot] and st.request is not None)
         return out
@@ -1196,18 +1482,30 @@ class ServingEngine:
     @property
     def load(self) -> int:
         """Scheduler load for least-loaded dispatch: queued + mid-prefill +
-        decoding requests."""
-        return len(self._queue) + len(self._prefilling) + self.n_active
+        decoding requests, plus (disaggregated roles) parked handoffs and
+        staged imports — both occupy slots, so they gate dispatch too."""
+        return (len(self._queue) + len(self._prefilling) + self.n_active
+                + len(self._handoffs) + len(self._imports))
 
     @property
     def idle(self) -> bool:
         return (not self._queue and not self._prefilling
-                and not self._active.any())
+                and not self._active.any()
+                and not self._handoffs and not self._imports)
 
     @property
     def queue_len(self) -> int:
         """Requests queued (arrived or future-dated), not yet admitted."""
         return len(self._queue)
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of slots held by decoding requests plus staged KV
+        imports — the decode pool's saturation signal for per-pool
+        autoscaling (a staged import IS a slot: it gates admission)."""
+        if not self.n_slots:
+            return 0.0
+        return (self.n_active + len(self._imports)) / self.n_slots
 
     def pending_arrival_times(self) -> list[float]:
         """Arrival times of every queued request — the Router's idle-wait
@@ -1479,6 +1777,26 @@ class ServingEngine:
             self._release_slot(slot)
             return
         S = prompt.shape[0]
+        eos = req.eos_token if req.eos_token is not None else -1
+        if self.role == "prefill" and first != eos and req.max_new_tokens > 1:
+            # prefill role: the decode belongs to the decode pool — park
+            # the slot with its KV resident and let the Router stream it
+            # out (kv_export_window) and release it (handoff_release).
+            # Requests that FINISH at the first token (eos / max_new 1)
+            # fall through and complete locally: shipping their KV would
+            # buy nothing. The prefix insert still happens here — the
+            # prefill pool's cache is what makes failover replays cheap.
+            if self._pfx is not None:
+                self._insert_prefix(slot, prompt)
+            self._handoffs[req.uid] = _Handoff(
+                req=req, slot=slot, first=first, pos=S,
+                prefix_hit_tokens=entry.length if entry is not None else 0,
+                t_admit=t_adm, t_first=t_first, entry=entry)
+            self.telemetry.counter("serving/handoffs_parked").inc()
+            if self.tracer is not None:
+                self.tracer.record(req.uid, "handoff_ready", t=t_first,
+                                   slot=slot)
+            return
         st = self._slots[slot]
         st.uid = req.uid
         st.remaining = req.max_new_tokens - 1
@@ -1496,6 +1814,8 @@ class ServingEngine:
         self._pos[slot] = S
         self._last_tok[slot] = first
         self._spec_len[slot] = 2  # adaptive draft cap re-ramps per request
+        self._spec_zero_streak[slot] = 0
+        self._spec_probe_wait[slot] = 0
         self._temp[slot] = req.temperature
         self._top_k[slot] = req.top_k
         self._top_p[slot] = req.top_p
@@ -1658,6 +1978,20 @@ class ServingEngine:
                 self._finish(slot, status="cancelled")
                 tm.counter("resilience/cancelled").inc()
                 return True
+        h = self._handoffs.pop(uid, None)
+        if h is not None:
+            if h.entry is not None:
+                self._pfx.release(h.entry)
+            self._free.append(h.slot)
+            self._synth_result(h.req, "cancelled", slot=h.slot)
+            tm.counter("resilience/cancelled").inc()
+            return True
+        imp = self._imports.pop(uid, None)
+        if imp is not None:
+            self._free.append(imp["slot"])
+            self._synth_result(imp["req"], "cancelled", slot=imp["slot"])
+            tm.counter("resilience/cancelled").inc()
+            return True
         return False
 
     def _sweep_deadlines(self, now: float):
@@ -1685,6 +2019,16 @@ class ServingEngine:
             if (self._active[slot] and st.request is not None
                     and now > self._deadline_of(st.request)):
                 self._finish(slot, status="deadline_exceeded")
+                tm.counter("resilience/deadline_evictions").inc()
+        for uid, h in list(self._handoffs.items()):
+            # a parked handoff past its deadline is evicted like a decoding
+            # slot: the Router's pump never committed it anywhere else
+            if now > self._deadline_of(h.req):
+                del self._handoffs[uid]
+                if h.entry is not None:
+                    self._pfx.release(h.entry)
+                self._free.append(h.slot)
+                self._synth_result(h.req, "deadline_exceeded", slot=h.slot)
                 tm.counter("resilience/deadline_evictions").inc()
 
     def _shed_overflow(self, now: float):
@@ -1829,13 +2173,35 @@ class ServingEngine:
             bonus = int(resample[slot, a]) if a < rl else int(clean[slot, a])
             burst = [int(x) for x in d[:a]] + [bonus] if rl else [bonus]
             if rl:
-                # AIMD draft-cap update: a fully-accepted draft doubles the
-                # slot's cap (ramping repetitive output to full depth in
-                # log2(depth) steps); any rejection halves it, parking
-                # mispredicting slots in the cheap small verify buckets
-                self._spec_len[slot] = (
-                    min(self.spec_cfg.depth, 4 * rl) if a == rl
-                    else max(2, rl // 2))
+                if a == 0:
+                    # acceptance-aware scheduling: consecutive ZERO-
+                    # acceptance verifies first floor the AIMD cap at 1
+                    # (cheapest verify bucket), then suppress drafting
+                    # entirely (cap 0 — plain decode steps) with a
+                    # DECAYING re-probe: each failed probe doubles the
+                    # wait before the next one, so a never-accepting
+                    # request converges to decode-step dispatch rates
+                    self._spec_zero_streak[slot] += 1
+                    streak = int(self._spec_zero_streak[slot])
+                    if streak >= _SPEC_SUPPRESS_AFTER:
+                        self._spec_len[slot] = 0
+                        self._spec_probe_wait[slot] = 1 << min(
+                            streak - _SPEC_SUPPRESS_AFTER,
+                            _SPEC_PROBE_WAIT_MAX_LOG2)
+                        tm.counter("serving/spec_suppressions").inc()
+                    else:
+                        self._spec_len[slot] = 1
+                else:
+                    # any acceptance clears the streak and resumes AIMD:
+                    # a fully-accepted draft doubles the slot's cap
+                    # (ramping repetitive output to full depth in
+                    # log2(depth) steps); a partial rejection halves it,
+                    # parking mispredicting slots in cheap small buckets
+                    self._spec_zero_streak[slot] = 0
+                    self._spec_probe_wait[slot] = 0
+                    self._spec_len[slot] = (
+                        min(self.spec_cfg.depth, 4 * rl) if a == rl
+                        else max(2, rl // 2))
             self._spec_drafted += rl
             self._spec_accepted += a
             tm.counter("serving/spec_drafted").inc(rl)
@@ -1939,6 +2305,21 @@ class ServingEngine:
                 cap = min(self.spec_cfg.depth, st.remaining,
                           int(self._spec_len[slot]))
                 if cap < 1:
+                    if self._spec_len[slot] == 0 and st.remaining > 0:
+                        # suppressed slot: this decode step pays ZERO
+                        # drafting/verify overhead. Tick down the decaying
+                        # probe timer; when it expires, re-arm a depth-1
+                        # probe so a workload that BECOMES predictable can
+                        # climb back onto the AIMD ramp
+                        self._spec_suppressed_steps += 1
+                        self.telemetry.counter(
+                            "serving/spec_suppressed_steps").inc()
+                        self._spec_probe_wait[slot] -= 1
+                        if self._spec_probe_wait[slot] <= 0:
+                            self._spec_len[slot] = 1
+                            self._spec_probes += 1
+                            self.telemetry.counter(
+                                "serving/spec_probes").inc()
                     continue
                 d = self._drafter.propose(
                     np.concatenate([
@@ -2062,6 +2443,8 @@ class ServingEngine:
             "drafted": int(drafted),
             "accepted": int(accepted),
             "acceptance_rate": (accepted / drafted) if drafted else 0.0,
+            "suppressed_steps": int(self._spec_suppressed_steps),
+            "probes": int(self._spec_probes),
         }
 
     def telemetry_snapshot(self) -> dict:

@@ -16,9 +16,13 @@ the slot's prompt+tokens, so a Router failover / quarantine requeue that
 replays the request from scratch starts with exactly the draft state a
 fresh request would have — nothing to reset, nothing to double-count.
 
-``draft_source="draft_model"`` is a reserved hook for a small draft model;
-the config validates it (runtime/config.SpeculationConfig) but
-``make_drafter`` rejects it until the model path is wired.
+``draft_source="draft_model"`` (EXPERIMENTAL) is a host-resident tiny
+draft model: a fixed random embedding + projection pair seeded from a
+constant, rolled out greedily on the host. It carries no trained weights —
+the point is the END-TO-END wiring (drafter protocol, verify buckets,
+failover replay identity) with a draft distribution that is *cheap and
+deterministic*, not *good*. Greedy parity still holds for the same reason
+as ngram: the verifier, not the draft, decides every emitted token.
 """
 
 from __future__ import annotations
@@ -104,10 +108,56 @@ class NgramDrafter:
         return np.zeros((0,), np.int32)
 
 
-def make_drafter(cfg: SpeculationConfig) -> NgramDrafter:
+class DraftModelDrafter:
+    """EXPERIMENTAL host-resident tiny draft model (docs/serving.md
+    "Speculative decoding > draft_model").
+
+    A fixed-seed random embedding table ``E [vocab, dim]`` and projection
+    ``P [dim, vocab]`` form a degenerate one-layer language model scored
+    entirely in numpy: the context vector is an exponentially-decayed mean
+    of recent-token embeddings, each draft token is the argmax of
+    ``ctx @ P``, and the rollout feeds its own prediction back in. Like
+    the n-gram drafter it is STATELESS across steps (rebuilt from the
+    slot's history every call) so failover replay produces identical
+    drafts, and DETERMINISTIC (constant seed, argmax with numpy's
+    first-index tie break) so greedy parity is bitwise."""
+
+    _DIM = 16  # embedding width — big enough to spread ties, host-cheap
+
+    def __init__(self, cfg: SpeculationConfig, vocab_size: int):
+        self.cfg = cfg
+        rng = np.random.default_rng(0xD5A57)  # constant: replicas agree
+        self._emb = rng.standard_normal(
+            (int(vocab_size), self._DIM)).astype(np.float32)
+        self._proj = rng.standard_normal(
+            (self._DIM, int(vocab_size))).astype(np.float32)
+
+    def propose(self, history: np.ndarray, depth: int) -> np.ndarray:
+        h = np.asarray(history).reshape(-1)
+        if depth < 1 or h.shape[0] == 0:
+            return np.zeros((0,), np.int32)
+        # decayed mean over (up to) the last 2*DIM tokens — O(DIM^2) host
+        # flops per call, independent of the full history length
+        ctx = np.zeros((self._DIM,), np.float32)
+        for t in h[-2 * self._DIM:]:
+            ctx = 0.5 * ctx + 0.5 * self._emb[int(t)]
+        out = []
+        for _ in range(depth):
+            nxt = int(np.argmax(ctx @ self._proj))
+            out.append(nxt)
+            ctx = 0.5 * ctx + 0.5 * self._emb[nxt]
+        return np.asarray(out, np.int32)
+
+
+def make_drafter(cfg: SpeculationConfig, vocab_size: int | None = None):
     """Drafter factory for ``serving.speculation.draft_source``."""
     if cfg.draft_source == "ngram":
         return NgramDrafter(cfg)
+    if cfg.draft_source == "draft_model":
+        if vocab_size is None:
+            raise ValueError(
+                "draft_source='draft_model' needs the model's vocab_size to "
+                "build its host-resident scorer")
+        return DraftModelDrafter(cfg, vocab_size)
     raise NotImplementedError(
-        "serving.speculation.draft_source='draft_model' is a reserved hook — "
-        "only the self-speculative 'ngram' drafter is wired up")
+        f"unknown serving.speculation.draft_source={cfg.draft_source!r}")

@@ -65,8 +65,8 @@ from typing import Optional
 import threading
 
 from ..inference.rpc import (ReplicaClient, RpcConnectionLost, RpcServer,
-                             _dec_value, decode_request, encode_request,
-                             encode_result)
+                             _dec_value, decode_kv_window, decode_request,
+                             encode_kv_window, encode_request, encode_result)
 from ..resilience.heartbeat import HeartbeatJudge
 from ..resilience.preemption import PreemptionGuard
 from ..resilience.retry import RetryPolicy, backoff_delay
@@ -75,12 +75,15 @@ from ..utils.durability import write_durable_bytes
 from ..utils.logging import logger
 
 
-def build_serving_engine(spec: dict, replica_id: int | str = 0):
+def build_serving_engine(spec: dict, replica_id: int | str = 0,
+                         role: str | None = None):
     """Deterministic engine construction from a plain-JSON spec:
     ``{"model": {TransformerConfig kwargs, "dtype": "float32"},
     "engine_dtype": "fp32", "serving": {ServingEngine config}}``.
     Params are initialized from ``PRNGKey(0)`` inside ``InferenceEngine``,
-    so every process building the same spec holds identical weights."""
+    so every process building the same spec holds identical weights.
+    ``role`` (the ``--role`` flag) overrides any ``serving.role`` in the
+    spec — disaggregated pools share ONE spec and differ only by flag."""
     import jax.numpy as jnp
 
     from ..inference import InferenceEngine
@@ -94,7 +97,7 @@ def build_serving_engine(spec: dict, replica_id: int | str = 0):
     engine = InferenceEngine(
         model=Model(cfg), config={"dtype": spec.get("engine_dtype", "fp32")})
     return ServingEngine(engine, config=dict(spec.get("serving", {})),
-                         replica_id=replica_id)
+                         replica_id=replica_id, role=role)
 
 
 class WorkerHost:
@@ -139,7 +142,8 @@ class WorkerHost:
 
     def ping(self) -> dict:
         return {"pid": os.getpid(), "mono": time.monotonic(),
-                "replica_id": self.engine.replica_id}
+                "replica_id": self.engine.replica_id,
+                "role": getattr(self.engine, "role", "both")}
 
     # -- scheduler surface ----------------------------------------------
 
@@ -149,6 +153,7 @@ class WorkerHost:
             "load": e.load, "idle": e.idle, "queue_len": e.queue_len,
             "arrived": e.arrived_queue_len(now),
             "pending": e.pending_arrival_times(),
+            "occupancy": getattr(e, "occupancy", 0.0),
         }
 
     def submit(self, request: dict) -> dict:
@@ -219,6 +224,11 @@ class WorkerHost:
             # the Router's mirror ingest costs zero extra RPCs; omitted
             # when empty (the common off/idle case adds no wire bytes)
             reply["rings"] = rings
+        if getattr(self.engine, "role", "both") == "prefill":
+            # parked prefill-complete requests ride the reply so the
+            # Router's handoff pump never polls — the disaggregated twin
+            # of the trace/spec piggybacks
+            reply["handoff"] = self.engine.handoff_ready()
         spec = self.engine.spec_stats()
         if spec is not None:
             # speculative acceptance counts ride the step reply exactly
@@ -272,6 +282,39 @@ class WorkerHost:
         return {str(u): encode_result(r)
                 for u, r in self.engine.drain().items()}
 
+    # -- disaggregated handoff surface (docs/serving.md) -----------------
+
+    def kv_export_window(self, uid, start, width,
+                         compression: str = "none") -> dict:
+        k, v = self.engine.kv_export_window(int(uid), int(start), int(width))
+        return encode_kv_window(k, v, str(compression))
+
+    def kv_import_window(self, uid, start, width, window: dict) -> dict:
+        k, v = decode_kv_window(window)
+        self.engine.kv_import_window(int(uid), int(start), int(width), k, v)
+        return self._state()
+
+    def kv_import_begin(self, request: dict, pos, first,
+                        prefix_hit_tokens=0, t_admit=0.0,
+                        t_first=0.0) -> dict:
+        slot = self.engine.kv_import_begin(
+            decode_request(request), pos=int(pos), first=int(first),
+            prefix_hit_tokens=int(prefix_hit_tokens),
+            t_admit=float(t_admit), t_first=float(t_first))
+        return {"slot": int(slot), **self._state()}
+
+    def kv_import_commit(self, uid) -> dict:
+        return {"committed": self.engine.kv_import_commit(int(uid)),
+                **self._state()}
+
+    def kv_import_abort(self, uid) -> dict:
+        return {"aborted": self.engine.kv_import_abort(int(uid)),
+                **self._state()}
+
+    def handoff_release(self, uid) -> dict:
+        return {"released": self.engine.handoff_release(int(uid)),
+                **self._state()}
+
     # -- observability ---------------------------------------------------
 
     def telemetry_snapshot(self) -> dict:
@@ -288,7 +331,9 @@ class WorkerHost:
             "ping", "submit", "requeue", "withdraw", "cancel", "result",
             "step", "live_requests", "reconcile", "arrived_queue_len",
             "prefix_match_len", "set_epoch", "drain", "telemetry_snapshot",
-            "compile_counts", "prefix_cache_stats")}
+            "compile_counts", "prefix_cache_stats",
+            "kv_export_window", "kv_import_window", "kv_import_begin",
+            "kv_import_commit", "kv_import_abort", "handoff_release")}
 
 
 def _pid_alive(pid: int) -> bool:
@@ -355,6 +400,12 @@ def main(argv=None) -> int:
     ap.add_argument("--platform", default="",
                     help="pin the jax platform for this worker (per-worker "
                          "device/platform assignment)")
+    ap.add_argument("--role", default="", choices=["", "both", "prefill",
+                                                   "decode"],
+                    help="disaggregated serving role: prefill workers park "
+                         "finished prefills for KV handoff, decode workers "
+                         "import KV and own decode/speculation "
+                         "(default: both)")
     args = ap.parse_args(argv)
 
     if args.platform:
@@ -378,7 +429,8 @@ def main(argv=None) -> int:
     guard.install()
 
     # engine BEFORE socket: a connectable socket means a servable worker
-    engine = build_serving_engine(spec, replica_id=rid)
+    engine = build_serving_engine(spec, replica_id=rid,
+                                  role=args.role or None)
     host = WorkerHost(engine, heartbeat=args.heartbeat or None)
     server = RpcServer(args.socket, host.handlers())
     # the RESOLVED address (a tcp://host:0 bind reports its real port):
@@ -439,6 +491,7 @@ class WorkerSupervisor:
                  seed: int = 0,
                  env: Optional[dict] = None,
                  worker_env: Optional[dict] = None,
+                 roles: Optional[dict] = None,
                  clock=None):
         if isinstance(transport, dict):
             transport = RouterTransportConfig(**transport)
@@ -465,6 +518,11 @@ class WorkerSupervisor:
         self.extra_env = dict(env or {})
         self.worker_env = {int(k): dict(v)
                            for k, v in (worker_env or {}).items()}
+        # slot -> serving role ("prefill"/"decode"/"both"): disaggregated
+        # pools differ only by this flag — same spec, same weights. A slot
+        # keeps its role across respawns (a replacement prefill worker is
+        # still a prefill worker); missing slots default to "both".
+        self.roles = {int(k): str(v) for k, v in (roles or {}).items()}
         self._procs: dict[int, subprocess.Popen] = {}
         self._clients: dict[int, ReplicaClient] = {}
         self._logs: dict[int, str] = {}
@@ -561,6 +619,9 @@ class WorkerSupervisor:
         cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.serving_worker",
                "--socket", addr, "--spec", self.spec_path,
                "--replica-id", str(slot), "--heartbeat", hb]
+        role = self.roles.get(slot)
+        if role:
+            cmd += ["--role", role]
         with open(log_path, "w") as log_f:
             proc = subprocess.Popen(cmd, env=env, stdout=log_f,
                                     stderr=subprocess.STDOUT,

@@ -1043,6 +1043,92 @@ class AutoscaleConfig:
 
 
 @dataclass
+class DisaggConfig:
+    """``serving.router.disagg`` block (consumed by
+    ``inference/router.Router`` + ``inference/autoscaler.Autoscaler``;
+    docs/serving.md "Disaggregated prefill/decode").
+
+    Splits the fleet into a PREFILL pool (admission + chunked prefill, then
+    a streamed KV handoff) and a DECODE pool (decode/speculation/SSE
+    progress) behind the same Router, because the two phases saturate
+    different resources (prefill: compute; decode: HBM bandwidth):
+
+    - ``enabled``: role-aware dispatch + per-request KV handoff state
+      machine. Off = every replica runs both phases (the co-located fleet).
+    - ``prefill_replicas`` / ``decode_replicas``: initial pool sizes for an
+      in-process disaggregated fleet (process-mode fleets size pools by the
+      roles their supervisor assigns).
+    - ``handoff_chunk``: KV wire-window width per export/import call — a
+      power of two in [8, 128], so the compiled ``kv_export``/``kv_import``
+      program families stay pow2-bounded exactly like chunked prefill.
+    - ``kv_compression``: ``none`` (bitwise-exact handoff, the default) or
+      ``int8`` (per-call absmax quantization on the wire — ~4x fewer
+      bytes, a bounded rounding error documented in docs/serving.md;
+      greedy parity is no longer bitwise).
+    - ``prefill_min_replicas`` / ``prefill_max_replicas`` and
+      ``decode_min_replicas`` / ``decode_max_replicas``: per-pool fleet
+      envelopes for the autoscaler (each pool scales on its OWN signals).
+    - ``prefill_scale_up_queue``: pool-wide arrived-request backlog at/past
+      which the prefill up-signal fires.
+    - ``prefill_scale_up_backlog``: pool-wide chunk backlog (slots mid-
+      prefill + finished slots parked awaiting handoff) at/past which the
+      prefill up-signal fires.
+    - ``decode_scale_up_occupancy``: mean decode-slot occupancy fraction
+      at/past which the decode up-signal fires.
+    - ``decode_scale_up_step_s``: decode-replica step latency past which
+      the decode up-signal fires (0 disables the latency signal).
+
+    Scale-down, hysteresis (``up_consecutive``/``down_consecutive``),
+    ``cooldown_s`` and the events ring reuse the ``autoscale`` block —
+    disagg only splits the SIGNALS and the min/max envelopes per pool.
+    """
+
+    enabled: bool = False
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    handoff_chunk: int = 64
+    kv_compression: str = "none"
+    prefill_min_replicas: int = 1
+    prefill_max_replicas: int = 4
+    decode_min_replicas: int = 1
+    decode_max_replicas: int = 4
+    prefill_scale_up_queue: int = 4
+    prefill_scale_up_backlog: int = 4
+    decode_scale_up_occupancy: float = 0.75
+    decode_scale_up_step_s: float = 0.0
+
+    def __post_init__(self):
+        if self.prefill_replicas < 1 or self.decode_replicas < 1:
+            raise DeepSpeedConfigError(
+                "serving.router.disagg prefill_replicas/decode_replicas "
+                "must be >= 1")
+        w = self.handoff_chunk
+        if w < 8 or w > 128 or (w & (w - 1)) != 0:
+            raise DeepSpeedConfigError(
+                f"serving.router.disagg.handoff_chunk must be a power of "
+                f"two in [8, 128], got {w}")
+        if self.kv_compression not in ("none", "int8"):
+            raise DeepSpeedConfigError(
+                f"serving.router.disagg.kv_compression must be none|int8, "
+                f"got {self.kv_compression!r}")
+        if self.prefill_min_replicas < 1 or self.decode_min_replicas < 1:
+            raise DeepSpeedConfigError(
+                "serving.router.disagg per-pool min replicas must be >= 1")
+        if (self.prefill_max_replicas < self.prefill_min_replicas
+                or self.decode_max_replicas < self.decode_min_replicas):
+            raise DeepSpeedConfigError(
+                "serving.router.disagg per-pool max replicas must be >= "
+                "the pool's min replicas")
+        if (self.prefill_scale_up_queue < 0
+                or self.prefill_scale_up_backlog < 0
+                or self.decode_scale_up_step_s < 0
+                or not 0.0 <= self.decode_scale_up_occupancy <= 1.0):
+            raise DeepSpeedConfigError(
+                "serving.router.disagg scale thresholds must be >= 0 "
+                "(decode_scale_up_occupancy in [0, 1])")
+
+
+@dataclass
 class GatewayConfig:
     """``serving.gateway`` block (consumed by
     ``launcher/http_gateway.HttpGateway``; docs/serving.md "HTTP front door
@@ -1130,8 +1216,9 @@ class SpeculationConfig:
       re-occur earlier in prompt+output before the drafter proposes its
       continuation. Higher = fewer, higher-confidence drafts.
     - ``draft_source``: ``ngram`` (the host-side self-drafter) or
-      ``draft_model`` (reserved hook for a small draft model — configs
-      validate, but the engine rejects it at construction until wired).
+      ``draft_model`` (EXPERIMENTAL: a host-resident tiny draft model —
+      deterministic, seeded from the serving seed; greedy parity still
+      holds because verification, not the draft, decides every token).
     """
 
     enabled: bool = False
@@ -1173,6 +1260,8 @@ class RouterConfig:
       (its own dataclass above; ignored by in-process fleets).
     - ``autoscale``: ledger-driven elastic scaling sub-block (its own
       dataclass above; docs/serving.md "Elastic fleet & brownout").
+    - ``disagg``: disaggregated prefill/decode sub-block (its own dataclass
+      above; docs/serving.md "Disaggregated prefill/decode").
     - ``journal``: durable request-journal sub-block (its own dataclass
       above; docs/serving.md "Crash-safe control plane").
     """
@@ -1184,6 +1273,7 @@ class RouterConfig:
     transport: RouterTransportConfig = field(
         default_factory=RouterTransportConfig)
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
+    disagg: DisaggConfig = field(default_factory=DisaggConfig)
     journal: JournalConfig = field(default_factory=JournalConfig)
 
     def __post_init__(self):
@@ -1193,6 +1283,8 @@ class RouterConfig:
             self.transport = _build(RouterTransportConfig, self.transport)
         if isinstance(self.autoscale, dict):
             self.autoscale = _build(AutoscaleConfig, self.autoscale)
+        if isinstance(self.disagg, dict):
+            self.disagg = _build(DisaggConfig, self.disagg)
         if isinstance(self.journal, dict):
             self.journal = _build(JournalConfig, self.journal)
         if self.replicas < 1:

@@ -194,6 +194,21 @@ def test_router_chaos_argv_contract_exits_2_with_usage(argv):
     assert "Traceback" not in proc.stderr
 
 
+@pytest.mark.parametrize("argv", [
+    ("--disagg", "7"),                      # unexpected operand
+    ("--disagg", "--disagg-seed", "xyz"),   # non-numeric seed
+    ("--disagg", "--disagg-seed"),          # dangling seed flag
+])
+def test_disagg_argv_contract_exits_2_with_usage(argv):
+    """``--disagg`` follows the sibling-drill contract: malformed operands
+    exit 2 with a usage line on stderr — never a traceback, never a
+    started drill."""
+    proc = _run_bench_argv(*argv)
+    assert proc.returncode == 2, (argv, proc.stderr)
+    assert "usage: bench.py --disagg" in proc.stderr
+    assert "Traceback" not in proc.stderr
+
+
 def test_drill_rows_carry_the_stamp_contract(bench):
     """Every CPU-pinned drill row (incl. the --gateway-chaos row) carries
     the full ``_stamp_row`` provenance block — platform cpu, comparable

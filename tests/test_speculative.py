@@ -81,21 +81,44 @@ def test_ngram_drafter_prefers_longest_then_most_recent_match():
     np.testing.assert_array_equal(d.propose(h2, 1), [2])
 
 
-def test_draft_model_source_is_a_typed_stub(engine):
-    # the config schema admits the reserved hook...
+def test_draft_model_drafter_is_deterministic_and_buildable():
     cfg = SpeculationConfig(enabled=True, draft_source="draft_model")
-    # ...but wiring it raises until a draft-model path exists, and the
-    # failure happens at ENGINE BUILD, not mid-serve
-    with pytest.raises(NotImplementedError):
+    # the host-resident scorer needs the vocab size; forgetting it fails
+    # at ENGINE BUILD, not mid-serve
+    with pytest.raises(ValueError):
         make_drafter(cfg)
-    with pytest.raises(NotImplementedError):
-        ServingEngine(engine, n_slots=2, max_seq_len=128,
-                      speculation={"enabled": True,
-                                   "draft_source": "draft_model"})
+    d = make_drafter(cfg, vocab_size=97)
+    h = np.array([1, 7, 8, 9, 10], np.int32)
+    a, b = d.propose(h, 4), d.propose(h, 4)
+    np.testing.assert_array_equal(a, b)  # stateless + constant seed
+    assert a.shape == (4,) and all(0 <= int(t) < 97 for t in a)
+    # a second drafter instance (a failover replica) proposes identically
+    np.testing.assert_array_equal(
+        make_drafter(cfg, vocab_size=97).propose(h, 4), a)
+    assert d.propose(h, 0).size == 0
     with pytest.raises(DeepSpeedConfigError):
         SpeculationConfig(draft_source="oracle")
     with pytest.raises(DeepSpeedConfigError):
         SpeculationConfig(depth=0)
+
+
+def test_draft_model_greedy_parity_vs_ngram(engine):
+    """EXPERIMENTAL draft_model source: the random-weight host drafter
+    produces the exact same greedy OUTPUT as the ngram drafter and plain
+    generate — acceptance decides tokens, drafts only decide cost."""
+    prompts = _prompts([5, 11, 23], seed=19)
+    reqs = lambda: [Request(uid=i, prompt=p, max_new_tokens=24)  # noqa: E731
+                    for i, p in enumerate(prompts)]
+    dm = ServingEngine(engine, n_slots=4, max_seq_len=128,
+                       speculation={**SPEC, "draft_source": "draft_model"},
+                       config={"watchdog_mode": "raise"})
+    res = dm.serve(reqs())
+    for i, p in enumerate(prompts):
+        np.testing.assert_array_equal(
+            res[i].tokens, engine.generate(p[None], max_new_tokens=24)[0])
+    stats = dm.spec_stats()
+    assert stats["draft_source"] == "draft_model"
+    assert stats["verify_steps"] > 0  # drafts really dispatched
 
 
 # -------------------------------------------------------- greedy parity
@@ -218,6 +241,49 @@ def test_verify_program_set_bounded_under_ragged_mix(engine):
                for i, p in enumerate(_prompts([7, 13, 21], seed=17))])
     assert srv.compile_counts()["verify"] == counts2["verify"]
     assert srv.compile_counts()["decode"] == 1
+
+
+class _AlwaysWrongDrafter:
+    """Proposes tokens guaranteed to differ from the greedy continuation:
+    zero acceptance on every verify, forever."""
+
+    def __init__(self, prompt, ref, vocab=97):
+        self._plen = int(prompt.shape[0])
+        self._ref = np.asarray(ref, np.int32)
+        self._vocab = vocab
+
+    def propose(self, history, depth):
+        idx = int(history.shape[0]) - self._plen  # next emit position
+        end = min(idx + depth, self._ref.shape[0])
+        if end <= idx:
+            return np.zeros((0,), np.int32)
+        return ((self._ref[idx:end] + 1) % self._vocab).astype(np.int32)
+
+
+def test_never_accepting_workload_converges_to_plain_decode(engine):
+    """Acceptance-aware scheduling: a slot whose drafts NEVER land gets
+    its cap floored at 1, then suppressed (cap 0) with decaying re-probes
+    — so verify dispatches become a vanishing fraction of steps instead
+    of a per-step tax. Output stays bitwise greedy throughout."""
+    N = 48
+    prompt = _prompts([11], seed=23)[0]
+    ref = engine.generate(prompt[None], max_new_tokens=N)[0]
+    srv = _spec_engine(engine, n_slots=2)
+    srv._drafter = _AlwaysWrongDrafter(prompt, ref)
+    res = srv.serve([Request(uid=0, prompt=prompt, max_new_tokens=N)])
+    np.testing.assert_array_equal(res[0].tokens, ref)  # parity held
+    stats = srv.spec_stats()
+    assert stats["accepted"] == 0
+    counters = srv.telemetry.registry.snapshot()["counters"]
+    assert counters["serving/spec_suppressions"] >= 1
+    assert counters["serving/spec_probes"] >= 1
+    # convergence: far more plain-decode steps than verify dispatches.
+    # Without suppression every emitted token pays a verify (~N of them);
+    # with the decaying probe schedule the tail is all decode steps.
+    assert stats["suppressed_steps"] > N // 2
+    assert stats["verify_steps"] <= 3 + 7  # streak ramp + probe taps
+    assert stats["suppressed_steps"] == counters["serving/spec_suppressed_steps"]
+    assert stats["probes"] == counters["serving/spec_probes"]
 
 
 # ------------------------------------------------------------ telemetry
