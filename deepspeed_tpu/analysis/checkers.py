@@ -567,3 +567,108 @@ def check_rename_durability(pf: PyFile) -> list[Finding]:
                 f"fsync the data (and the directory) before the rename "
                 f"commits it, or pragma a non-durability rename"))
     return out
+
+
+# ---------------------------------------------------------------------------
+# secret-hygiene — PR 19: the gateway's bearer-token auth made credential
+# values reachable from serving code; one of them in a metric name, trace
+# event, journal record, JSONL export or log line is a durable credential
+# leak (journals and JSONL outlive the process and ride incident bundles)
+
+
+# exact-match identifier/attr/dict-key names that denote a CREDENTIAL.
+# Deliberately narrow: this serving codebase says "token" for VOCAB ids
+# everywhere (tokens_out, eos_token, tokens_sent) — only the exact,
+# singular credential spellings flag, so token-count telemetry stays
+# clean without pragmas.
+_SECRET_NAMES = frozenset({
+    "token", "secret", "api_key", "apikey", "auth_token", "bearer_token",
+    "access_token", "password", "authorization", "bearer", "credentials",
+})
+# call names whose arguments become durable/observable output: registry
+# metrics, request-trace events, journal records, JSONL emit, logs
+_SECRET_SINKS = frozenset({
+    "counter", "gauge", "histogram",                      # registry metrics
+    "record", "event",                                    # trace events
+    "record_submit", "record_terminal", "record_cancel",  # journal records
+    "record_idem",
+    "emit",                                               # JSONL exporter
+    "print", "log_dist", "info", "warning", "error",      # logs
+    "debug", "exception", "critical",
+})
+# an enclosing call whose name carries one of these is a digest wrapper:
+# hashing a credential before export is the SANCTIONED spelling
+_DIGEST_MARKS = ("digest", "sha", "hash")
+
+
+def _secretish(node: ast.AST) -> Optional[str]:
+    """The credential name a node spells, or None: a bare identifier, an
+    attribute terminal, or an exact string constant (dict keys, kwarg-by-
+    string); substring matches stay clean by construction."""
+    if isinstance(node, ast.Name) and node.id.lower() in _SECRET_NAMES:
+        return node.id
+    if (isinstance(node, ast.Attribute)
+            and node.attr.lower() in _SECRET_NAMES):
+        return node.attr
+    if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+            and node.value.lower() in _SECRET_NAMES):
+        return node.value
+    return None
+
+
+def _is_digest_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = (_terminal_name(node.func) or "").lower()
+    return any(m in name for m in _DIGEST_MARKS)
+
+
+def _secret_leaks(node: ast.AST) -> list[tuple[str, int]]:
+    """Credential spellings inside ``node`` NOT wrapped in a digest call
+    — the digest of a secret is exactly what a metric/journal/log is
+    allowed to carry."""
+    out = []
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if _is_digest_call(n):
+            continue  # digest-wrapped access: exempt, don't descend
+        name = _secretish(n)
+        if name is not None:
+            out.append((name, getattr(n, "lineno", 0)))
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+@rule("secret-hygiene",
+      "identifiers/attrs/string keys spelling a credential (token, secret, "
+      "api_key, ...) must not reach registry metrics, trace events, journal "
+      "records, JSONL emit, or log/print sinks — export the sha256 digest "
+      "instead (digest-wrapped access is exempt); PR 19 gateway-auth "
+      "incident")
+def check_secret_hygiene(pf: PyFile) -> list[Finding]:
+    out = []
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        sink = _terminal_name(node.func)
+        if sink not in _SECRET_SINKS:
+            continue
+        hits: list[tuple[str, int]] = []
+        for arg in node.args:
+            hits.extend(_secret_leaks(arg))
+        for kw in node.keywords:
+            if kw.arg and kw.arg.lower() in _SECRET_NAMES:
+                if not _is_digest_call(kw.value):
+                    hits.append((kw.arg, kw.value.lineno))
+                continue
+            hits.extend(_secret_leaks(kw.value))
+        for name, lineno in hits:
+            out.append(Finding(
+                "secret-hygiene", pf.rel, lineno or node.lineno,
+                f"credential-named value {name!r} reaches sink "
+                f"{sink}(...) — a raw token in a metric/trace/journal/"
+                f"JSONL/log is a durable credential leak; export "
+                f"sha256(...).hexdigest() instead, or pragma a value that "
+                f"is provably not a secret"))
+    return out

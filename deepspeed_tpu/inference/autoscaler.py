@@ -181,10 +181,18 @@ class Autoscaler:
         healthy = [r for r in self.router._replicas if r.state == "healthy"]
         n = len(healthy)
         load = sum(r.engine.load for r in healthy)
+        queue = sum(r.engine.queue_len for r in healthy)
+        # noisy-neighbor containment (docs/serving.md "Multi-tenant
+        # isolation"): backlog a tenant holds ABOVE its quota never
+        # counts toward scale-up — the aggressor's burst is answered by
+        # its own 429s/brownout, not by growing the fleet for everyone
+        ex_fn = getattr(self.router, "tenant_excess", None)
+        excess = int(ex_fn()) if ex_fn is not None else 0
         return {
             "healthy": n,
             "target": self.target,
-            "queue": sum(r.engine.queue_len for r in healthy),
+            "queue": max(0, queue - excess),
+            "tenant_excess": excess,
             "load": load,
             "load_per_replica": load / max(1, n),
             "step_sec": max((r.last_step_sec for r in healthy), default=0.0),

@@ -50,6 +50,15 @@ Record types (``{"t": ...}``):
                    whose submit record was dropped once the uid went
                    terminal.
 
+Keys are OPAQUE strings end to end: since the multi-tenant PR the Router
+journals tenant-scoped composites (``router.tenant_idem_key``) and the
+encoded request carries its ``tenant`` field, but the journal format is
+unchanged — a v1 (tenant-less) journal replays cleanly, its bare keys
+landing in the anonymous-tenant pool and its requests decoding with
+``tenant=""`` via the codec default. Raw auth tokens NEVER appear here:
+the gateway authenticates against stored digests and journals only
+tenant ids (docs/serving.md "Multi-tenant isolation").
+
 Durability: each append is flush+fsync'd (``fsync: false`` trades the
 last few records for latency — replay still handles the torn tail), and
 rotation/compaction rewrites the file with the checkpoint saver's

@@ -85,7 +85,7 @@ from ..resilience.retry import backoff_delay
 from ..runtime.config import (FaultInjectionConfig, IncidentConfig,
                               RequestTraceConfig, RouterConfig,
                               RouterHealthConfig, SLOConfig,
-                              TimeSeriesConfig)
+                              TenantConfig, TimeSeriesConfig)
 from ..telemetry import (IncidentRecorder, RequestTracer, SLOTracker,
                          Telemetry, TimeSeriesStore)
 from ..telemetry.request_trace import RESERVED_UID_BASE
@@ -136,6 +136,21 @@ class _Replica:
         return self.state in ("healthy", "draining")
 
 
+def tenant_idem_key(tenant: str, key: str) -> str:
+    """Composite idempotency-map key scoping ``key`` to ``tenant``
+    (docs/serving.md "Multi-tenant isolation"): a colliding
+    ``X-DSTPU-Idempotency-Key`` from a DIFFERENT tenant must never replay
+    the original tenant's uid/result. The separator is a control char no
+    validated client key or tenant id can contain (the gateway rejects
+    control chars in keys with 400; config rejects them in tenant ids), so
+    composites cannot be forged. Anonymous submits (tenant ``""``) keep
+    the BARE key — which is exactly the legacy-journal replay shim: a v1
+    journal's tenant-less idem records recover into the anonymous pool
+    unchanged, and the journal file format never changes (keys are opaque
+    strings end to end)."""
+    return f"{tenant}\x1f{key}" if tenant else str(key)
+
+
 class Router:
     """N ``ServingEngine`` replicas behind one submit/step/cancel surface.
 
@@ -173,6 +188,13 @@ class Router:
         self.health: RouterHealthConfig = rc.health
         self.affinity = bool(rc.affinity)
         self.max_queue_len = int(rc.max_queue_len)
+        # per-tenant isolation policy (docs/serving.md "Multi-tenant
+        # isolation"): the router consumes weight/max_queued for brownout
+        # ordering and fleet stats; every replica engine reads the SAME
+        # ``tenants`` block from the shared sub-config for its DWRR pop
+        # and per-replica quota. Empty = legacy anonymous single tenant.
+        self._tenants: dict[str, TenantConfig] = {}
+        self.set_tenant_policy(config.get("tenants", {}), _propagate=False)
         # disaggregated prefill/decode serving (docs/serving.md
         # "Disaggregated prefill/decode"): when enabled, dispatch targets
         # the PREFILL pool only and _pump_handoffs streams finished
@@ -429,10 +451,16 @@ class Router:
             # ladder rung 1: a browned-out fleet grants no open-ended
             # latency budgets — deadline-free work gets the brownout
             # deadline so a saturated backlog self-limits instead of
-            # growing stale entries forever
-            request = replace(request,
-                              deadline_s=self._brownout_deadline_s)
-            tm.counter("router/autoscale/brownout_deadlines").inc()
+            # growing stale entries forever. Tenant-first ordering: while
+            # some tenant sits over its quota, ONLY over-quota tenants'
+            # arrivals are tightened — conformant tenants keep their open
+            # budgets until the aggressor's own backlog is contained
+            # (legacy uniform tightening when no tenant is over quota).
+            over = self._over_quota_tenants()
+            if not over or request.tenant in over:
+                request = replace(request,
+                                  deadline_s=self._brownout_deadline_s)
+                tm.counter("router/autoscale/brownout_deadlines").inc()
         if self.max_queue_len and request.arrival_time <= now:
             # same population rule as the per-engine bound: requeued uids
             # (quarantine replays, failovers) sit outside the accounting
@@ -441,6 +469,7 @@ class Router:
             if arrived >= self.max_queue_len and not (
                     self._brownout and self._shed_lower_priority(request)):
                 tm.counter("router/shed").inc()
+                self._count_reject(request.tenant)
                 if self._brownout:
                     tm.counter("router/autoscale/overloaded_rejects").inc()
                     raise RequestRejected(
@@ -466,6 +495,12 @@ class Router:
             try:
                 uid = target.engine.submit(request)
                 break
+            except RequestRejected:
+                # typed per-replica rejection (tenant_quota / queue_full):
+                # count it against the tenant, then let the caller's typed
+                # back-off contract see the original reason
+                self._count_reject(request.tenant)
+                raise
             except RpcError as e:
                 # a dispatch that cannot reach its replica earns its
                 # verdict early, on the SAME mapping as step(): a timeout
@@ -490,15 +525,24 @@ class Router:
         self._owner[uid] = target.rid
         self._seen.setdefault(uid, set()).add(target.rid)
         self._requests[uid] = request
-        if idempotency_key:
-            self._idem[str(idempotency_key)] = uid
+        scoped_key = (tenant_idem_key(request.tenant, str(idempotency_key))
+                      if idempotency_key else None)
+        if scoped_key:
+            # tenant-scoped: a colliding key from another tenant maps to a
+            # DIFFERENT composite, so it can never replay this uid
+            self._idem[scoped_key] = uid
+        if request.tenant:
+            tm.counter(f"tenant/{request.tenant}/accepted").inc()
         if self._journal is not None:
             # the accept boundary: dispatch succeeded, so this request is
             # PROMISED — the journal learns it before the caller does. (A
             # crash in the window between the worker's accept and this
             # append leaves only an orphan the owner map never points to,
-            # the documented lost-reply semantics.)
-            self._journal.record_submit(request, key=idempotency_key)
+            # the documented lost-reply semantics.) The journal stores the
+            # COMPOSITE idem key — replay rebuilds the tenant-scoped map
+            # without a format change; bare v1 keys land in the anonymous
+            # pool (tenant_idem_key docstring).
+            self._journal.record_submit(request, key=scoped_key)
         target.dispatched += 1
         tm.counter("router/dispatched").inc()
         if self.tracer is not None:
@@ -506,15 +550,25 @@ class Router:
         self._update_gauges()
         return uid
 
-    def idempotency_lookup(self, key: str) -> Optional[int]:
-        """The uid an idempotency key already maps to (None if never
-        seen) — journal-backed, so the mapping survives a restart."""
-        return self._idem.get(str(key))
+    def idempotency_lookup(self, key: str, tenant: str = "") -> Optional[int]:
+        """The uid an idempotency key already maps to for THIS tenant
+        (None if never seen) — journal-backed, so the mapping survives a
+        restart. Keys are tenant-scoped: another tenant's identical key
+        resolves to a different composite and can never leak a uid across
+        the boundary; anonymous callers share the bare-key legacy pool."""
+        return self._idem.get(tenant_idem_key(tenant, str(key)))
 
     def idempotency_map(self) -> dict[str, int]:
         """A copy of the full key -> uid mapping (the gateway seeds its
         own cache from this after a recovery)."""
         return dict(self._idem)
+
+    def request_tenant(self, uid: int) -> Optional[str]:
+        """The tenant owning live request ``uid`` (None when unknown or
+        terminal) — the gateway's resume/fetch ownership check reads this
+        for uids it did not mint itself (journal-recovered bands)."""
+        req = self._requests.get(uid)
+        return req.tenant if req is not None else None
 
     def max_uid_in_band(self, lo: int, hi: int) -> int:
         """Highest uid in ``[lo, hi)`` this router knows (live or
@@ -614,17 +668,79 @@ class Router:
         self._brownout_deadline_s = float(deadline_s) if on else 0.0
         self.telemetry.gauge("router/autoscale/brownout").set(1 if on else 0)
 
+    # -- multi-tenant isolation (docs/serving.md) ------------------------
+
+    def set_tenant_policy(self, tenants: dict, *,
+                          _propagate: bool = True) -> None:
+        """Install (or replace) the per-tenant policy fleet-wide: the
+        router keeps weight/max_queued for brownout ordering and stats,
+        and forwards the block to every in-process replica engine's DWRR
+        scheduler (worker processes read the same ``tenants`` block from
+        their boot config). Host-side state only — hot-swappable."""
+        pol: dict[str, TenantConfig] = {}
+        for tid, block in dict(tenants or {}).items():
+            pol[str(tid)] = (block if isinstance(block, TenantConfig)
+                             else TenantConfig(**dict(block)))
+        self._tenants = pol
+        if _propagate:
+            for r in self._replicas:
+                fn = getattr(r.engine, "set_tenant_policy", None)
+                if fn is not None:
+                    fn(tenants)
+
+    def _tenant_live_counts(self) -> dict[str, int]:
+        """Live accepted (queued or running) requests per tenant, from the
+        router's OWN request copies — journal recovery rebuilds
+        ``_requests``, so this accounting survives a restart for free."""
+        live: dict[str, int] = {}
+        for req in self._requests.values():
+            if req.tenant:
+                live[req.tenant] = live.get(req.tenant, 0) + 1
+        return live
+
+    def _over_quota_tenants(self) -> set[str]:
+        """Tenants currently holding MORE live requests than their
+        ``max_queued`` quota — the brownout ladder degrades these first
+        (docs/serving.md "Multi-tenant isolation")."""
+        if not self._tenants:
+            return set()
+        live = self._tenant_live_counts()
+        return {t for t, tc in self._tenants.items()
+                if tc.max_queued > 0 and live.get(t, 0) > tc.max_queued}
+
+    def _count_reject(self, tenant: str) -> None:
+        if tenant:
+            self.telemetry.counter(f"tenant/{tenant}/rejected").inc()
+
+    def tenant_excess(self) -> int:
+        """Fleet backlog attributable to tenants sitting OVER their
+        ``max_queued`` quota. The autoscaler subtracts this from its
+        queue-depth scale signal: an aggressor's burst is ITS problem
+        (typed 429s / tenant-first brownout), not a reason to grow the
+        fleet — noisy-neighbor containment extends to capacity spend."""
+        if not self._tenants:
+            return 0
+        live = self._tenant_live_counts()
+        return sum(max(0, live.get(t, 0) - tc.max_queued)
+                   for t, tc in self._tenants.items() if tc.max_queued > 0)
+
     def _shed_lower_priority(self, request: Request) -> bool:
         """Brownout ladder rung 2: make room for ``request`` by shedding
         the lowest-priority NEWEST still-QUEUED request (admitted work —
         prefill/decode already paid for — is never discarded). False when
-        nothing queued is lower priority than the arrival."""
+        nothing queued is lower priority than the arrival. Tenant-first
+        ordering: among eligible victims, requests from tenants currently
+        OVER their quota shed before any conformant tenant's work — the
+        noisy neighbor absorbs its own brownout first (docs/serving.md
+        "Multi-tenant isolation")."""
+        over = self._over_quota_tenants()
         victims = sorted(
             (req for uid, req in self._requests.items()
              if req.priority < request.priority
              and self._owner.get(uid) is not None
              and self._replicas[self._owner[uid]].stepped),
-            key=lambda r: (r.priority, -r.arrival_time, -r.uid))
+            key=lambda r: (r.tenant not in over, r.priority,
+                           -r.arrival_time, -r.uid))
         for victim in victims[:8]:  # bounded withdraw probes per submit
             r = self._replicas[self._owner[victim.uid]]
             try:
@@ -806,6 +922,8 @@ class Router:
             arrival_time=req.arrival_time, finish_time=now, status=status)
         self._results[req.uid] = res
         self._requests.pop(req.uid, None)
+        if req.tenant and status.startswith("shed"):
+            self.telemetry.counter(f"tenant/{req.tenant}/sheds").inc()
         if self._journal is not None:
             # skips uids the journal never accepted (a shed submit's
             # synthesized result) — record_terminal filters those
@@ -1646,6 +1764,17 @@ class Router:
                 "decode_replicas": len(self._accepting("decode")),
                 "handoffs": self._handoffs_done,
                 "parked_backlog": self._handoff_backlog,
+            }
+        if self._tenants:
+            live = self._tenant_live_counts()
+            out["tenants"] = {
+                t: {
+                    "weight": tc.weight,
+                    "max_queued": tc.max_queued,
+                    "live": live.get(t, 0),
+                    "over_quota": (tc.max_queued > 0
+                                   and live.get(t, 0) > tc.max_queued),
+                } for t, tc in sorted(self._tenants.items())
             }
         if self._inj is not None:
             out["fault_injection"] = self._inj.stats()

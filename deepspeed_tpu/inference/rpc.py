@@ -164,6 +164,7 @@ def encode_request(req) -> dict:
         "arrival_time": float(req.arrival_time),
         "deadline_s": float(req.deadline_s),
         "priority": int(getattr(req, "priority", 0)),
+        "tenant": str(getattr(req, "tenant", "")),
     }
 
 

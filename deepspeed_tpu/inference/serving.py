@@ -110,7 +110,8 @@ from ..resilience import FaultInjector, RequestRejected
 from ..runtime.config import (ChunkedPrefillConfig, FaultInjectionConfig,
                               IncidentConfig, LedgerConfig, PrefixCacheConfig,
                               RequestTraceConfig, SLOConfig,
-                              SpeculationConfig, TimeSeriesConfig)
+                              SpeculationConfig, TenantConfig,
+                              TimeSeriesConfig)
 from ..telemetry import (IncidentRecorder, RequestTracer, Telemetry,
                          TimeSeriesStore, classify_terminal, hbm_snapshot,
                          tree_bytes)
@@ -150,7 +151,11 @@ class Request:
     orders overload shedding only (higher = kept longer): when a browned-
     out Router's global queue bound is hit, the lowest-priority newest
     queued request is shed first (docs/serving.md "Elastic fleet &
-    brownout"); it never affects admission or decode order."""
+    brownout"); it never affects admission or decode order. ``tenant`` is
+    the caller's identity for fair scheduling, quota accounting, and
+    idempotency scoping (docs/serving.md "Multi-tenant isolation") — a
+    HOST-SIDE label only: it never becomes a traced operand, so an
+    arbitrary tenant mix admits with zero new XLA programs."""
 
     uid: int
     prompt: np.ndarray  # [S] int32
@@ -162,6 +167,7 @@ class Request:
     arrival_time: float = 0.0
     deadline_s: float = 0.0
     priority: int = 0
+    tenant: str = ""
 
 
 @dataclass
@@ -1007,6 +1013,17 @@ class ServingEngine:
         self.default_deadline_s = float(config.get("default_deadline_s", 0.0))
         self.quarantine_max_requeues = int(config.get("quarantine_max_requeues", 1))
         self.slot_quarantine_after = int(config.get("slot_quarantine_after", 2))
+        # -- multi-tenant isolation (docs/serving.md) -------------------
+        # tenant id -> TenantConfig. Purely host-side scheduler state: the
+        # tenant axis never reaches a traced operand, so an arbitrary
+        # tenant mix admits with ZERO new XLA programs. Empty policy (the
+        # default) keeps the legacy single-pool FIFO semantics exactly.
+        self._tenants: dict[str, TenantConfig] = {}
+        self.set_tenant_policy(config.get("tenants", {}))
+        # DWRR scheduler state: per-tenant deficit counters plus a rotation
+        # cursor (tenant name, so ring membership churn can't skew it)
+        self._dwrr_deficit: dict[str, float] = {}
+        self._dwrr_at: str = ""
         fi = (fault_injection if fault_injection is not None
               else config.get("fault_injection", {}))
         if isinstance(fi, dict):
@@ -1076,6 +1093,10 @@ class ServingEngine:
         # quarantine bookkeeping: per-uid replay count, per-slot consecutive
         # NaN-fault count, and slots pulled from rotation (suspect hardware)
         self._requeues: dict[int, int] = {}
+        # uid -> tenant id for live requests (per-tenant terminal metrics;
+        # popped on terminal). Anonymous requests (tenant "") stay out, so
+        # single-tenant deployments grow zero extra registry entries.
+        self._uid_tenant: dict[int, str] = {}
         self._slot_faults = np.zeros((n,), np.int32)
         self._quarantined_slots: set[int] = set()
         # uids exempt from queue-bound accounting: a Router's failover /
@@ -1170,6 +1191,22 @@ class ServingEngine:
 
     # -- scheduler ------------------------------------------------------
 
+    def set_tenant_policy(self, tenants: dict) -> None:
+        """Install (or replace) the per-tenant scheduling policy: a mapping
+        of tenant id -> ``TenantConfig`` (or an equivalent dict block).
+        Hot-swappable between steps — host-side state only, so a policy
+        change never invalidates a compiled program. An empty mapping
+        restores the legacy single-pool FIFO semantics."""
+        pol: dict[str, TenantConfig] = {}
+        for tid, block in dict(tenants or {}).items():
+            pol[str(tid)] = (block if isinstance(block, TenantConfig)
+                             else TenantConfig(**dict(block)))
+        self._tenants = pol
+
+    def _tenant_weight(self, tenant: str) -> float:
+        tc = self._tenants.get(tenant)
+        return tc.weight if tc is not None else 1.0
+
     def submit(self, request: Request) -> int:
         """Enqueue a request (admitted by the next step()/serve() iteration
         whose clock has passed its arrival_time)."""
@@ -1209,8 +1246,32 @@ class ServingEngine:
                         request.uid, "queue_full",
                         f"{arrived} arrived requests already queued "
                         f"(max_queue_len={self.max_queue_len})")
+        tc = self._tenants.get(request.tenant)
+        if tc is not None and tc.max_queued > 0:
+            # per-tenant queue-depth quota: enforced even under global
+            # headroom, so one tenant's burst is contained by its OWN cap
+            # (typed 429 upstream) instead of degrading its neighbors.
+            # Same exemption rule as the global bound: requeues/replays
+            # were already accepted once and never re-count.
+            now = time.perf_counter() - self._epoch
+            if (request.arrival_time <= now
+                    and request.uid not in self._exempt_uids):
+                mine = sum(
+                    1 for r in self._queue
+                    if r.tenant == request.tenant and r.arrival_time <= now
+                    and self._requeues.get(r.uid, 0) == 0
+                    and r.uid not in self._exempt_uids)
+                if mine >= tc.max_queued:
+                    self.telemetry.counter(
+                        f"tenant/{request.tenant}/rejected").inc()
+                    raise RequestRejected(
+                        request.uid, "tenant_quota",
+                        f"tenant {request.tenant!r} has {mine} arrived "
+                        f"requests queued (max_queued={tc.max_queued})")
         if request.deadline_s > 0:
             self._deadlines_armed = True
+        if request.tenant:
+            self._uid_tenant[request.uid] = request.tenant
         self._queue.append(request)
         if self.tracer is not None:
             # a future-dated request's timeline starts at its logical
@@ -1247,6 +1308,7 @@ class ServingEngine:
             if r.uid == uid:
                 del self._queue[i]
                 self._exempt_uids.discard(uid)
+                self._uid_tenant.pop(uid, None)
                 return r
         return None
 
@@ -1657,6 +1719,63 @@ class ServingEngine:
         del self._queue[best_i]
         return req
 
+    def _pop_tenant_fair(self, now: float) -> Optional[Request]:
+        """Deficit-weighted round robin over per-tenant arrival queues
+        (docs/serving.md "Multi-tenant isolation"). Within a tenant the
+        order stays earliest-arrival FIFO; across tenants each admission
+        visit pays one unit of deficit, topped up by the tenant's
+        configured weight, so long-run admission shares converge to the
+        weight ratios regardless of offered load. Pure host code — the
+        tenant axis never becomes a traced operand. With at most one
+        tenant backlogged this reduces EXACTLY to the legacy
+        earliest-arrival pop (including its head-of-line fix)."""
+        # earliest arrived candidate per tenant (FIFO within a tenant)
+        best: dict[str, int] = {}
+        for i, r in enumerate(self._queue):
+            if r.arrival_time > now:
+                continue
+            j = best.get(r.tenant)
+            if j is None or r.arrival_time < self._queue[j].arrival_time:
+                best[r.tenant] = i
+        if not best:
+            return None
+        if len(best) == 1:
+            (i,) = best.values()
+            req = self._queue[i]
+            del self._queue[i]
+            return req
+        # idle tenants bank no credit: a deficit persists only while its
+        # tenant stays backlogged, so a returning burster starts from zero
+        for t in [t for t in self._dwrr_deficit if t not in best]:
+            del self._dwrr_deficit[t]
+        ring = sorted(best)
+        n = len(ring)
+        idx = ring.index(self._dwrr_at) if self._dwrr_at in ring else 0
+        # config validates weight >= 0.01, so every tenant crosses one
+        # unit of deficit within 100 ring passes; the spin bound below is
+        # therefore unreachable and exists purely as a defensive fallback
+        for _ in range(101 * n):
+            t = ring[idx]
+            d = self._dwrr_deficit.get(t, 0.0)
+            if d < 1.0:
+                d += self._tenant_weight(t)  # one top-up per visit
+            if d >= 1.0:
+                d -= 1.0
+                self._dwrr_deficit[t] = d
+                # keep serving this tenant while its quantum lasts; once
+                # the deficit is spent the cursor moves on BEFORE the next
+                # top-up, so a heavyweight tenant cannot re-arm in place
+                # and starve the ring
+                self._dwrr_at = t if d >= 1.0 else ring[(idx + 1) % n]
+                i = best[t]
+                req = self._queue[i]
+                del self._queue[i]
+                return req
+            self._dwrr_deficit[t] = d
+            idx = (idx + 1) % n
+            self._dwrr_at = ring[idx]
+        return self._pop_earliest_arrived(now)
+
     def _admit(self, now: float):
         """Move arrived requests from the queue into free slots. Without
         prefix/chunk features this runs the legacy one-shot bucketed prefill;
@@ -1664,7 +1783,7 @@ class ServingEngine:
         ``prefilling`` state for step() to advance chunk by chunk."""
         tm = self.telemetry
         while self._free and self._queue:
-            req = self._pop_earliest_arrived(now)
+            req = self._pop_tenant_fair(now)
             if req is None:
                 break
             slot = self._free.popleft()
@@ -1879,6 +1998,8 @@ class ServingEngine:
         if self.slo_cfg.enabled:
             classify_terminal(tm.registry, self.slo_cfg, status, res.ttft,
                               tpot if len(res.tokens) > 1 else None)
+        self._tenant_terminal(res.uid, status, res.ttft,
+                              tpot if len(res.tokens) > 1 else None)
         tm.emit({
             "type": "request", "uid": res.uid, "slot": slot,
             "prompt_len": res.prompt_len, "n_tokens": int(len(res.tokens)),
@@ -1890,6 +2011,33 @@ class ServingEngine:
             self.tracer.record(res.uid, "terminal", t=res.finish_time,
                                status=status, n_tokens=int(len(res.tokens)))
         self._release_slot(slot)
+
+    def _tenant_terminal(self, uid: int, status: str, ttft: float,
+                         tpot: Optional[float]) -> None:
+        """Per-tenant terminal accounting (docs/serving.md "Multi-tenant
+        isolation"): latency percentiles, shed counters, and SLO attainment
+        keyed ``tenant/<id>/...``. No-op for anonymous requests, so the
+        single-tenant registry footprint is unchanged."""
+        t = self._uid_tenant.pop(uid, "")
+        if not t:
+            return
+        tm = self.telemetry
+        tm.counter(f"tenant/{t}/requests").inc()
+        if status == "ok":
+            tm.histogram(f"tenant/{t}/ttft_sec").observe(ttft)
+            if tpot is not None:
+                tm.histogram(f"tenant/{t}/tpot_sec").observe(tpot)
+        elif status.startswith("shed"):
+            tm.counter(f"tenant/{t}/sheds").inc()
+        if self.slo_cfg.enabled:
+            # same verdict logic as classify_terminal, scoped to the tenant
+            ok = (status == "ok"
+                  and not (ttft > self.slo_cfg.ttft_s > 0)
+                  and not (tpot is not None and tpot > self.slo_cfg.tpot_s > 0))
+            if ok:
+                tm.counter(f"tenant/{t}/slo_ok").inc()
+            else:
+                tm.counter(f"tenant/{t}/slo_miss").inc()
 
     def _release_slot(self, slot: int):
         """Host-side slot teardown shared by every terminal path (finish,
@@ -1929,6 +2077,7 @@ class ServingEngine:
         if self.slo_cfg.enabled:
             classify_terminal(self.telemetry.registry, self.slo_cfg,
                               status, 0.0, None)
+        self._tenant_terminal(req.uid, status, 0.0, None)
         self.telemetry.emit({
             "type": "request", "uid": req.uid, "slot": slot,
             "prompt_len": res.prompt_len, "n_tokens": 0, "status": status,
@@ -2459,6 +2608,27 @@ class ServingEngine:
         attributable. Also appended to the JSONL log (type ``snapshot``)
         when a sink is configured."""
         from ..comm.logger import comms_logger
+
+        # lazy per-tenant occupancy gauges, refreshed only at snapshot time
+        # (docs/serving.md "Multi-tenant isolation"): arrival-queue depth
+        # and HBM-slot occupancy per live tenant — pure host counting
+        if self._uid_tenant:
+            qd: dict[str, int] = {}
+            occ: dict[str, int] = {}
+            for r in self._queue:
+                if r.tenant:
+                    qd[r.tenant] = qd.get(r.tenant, 0) + 1
+            for s in self._slots:
+                t = self._uid_tenant.get(s.uid) if s.uid >= 0 else None
+                if t:
+                    occ[t] = occ.get(t, 0) + 1
+            for p in self._prefilling.values():
+                t = self._uid_tenant.get(p.req.uid)
+                if t:
+                    occ[t] = occ.get(t, 0) + 1
+            for t in set(qd) | set(occ):
+                self.telemetry.gauge(f"tenant/{t}/queued").set(qd.get(t, 0))
+                self.telemetry.gauge(f"tenant/{t}/slots").set(occ.get(t, 0))
 
         extra = {}
         if self._pfx is not None:

@@ -31,11 +31,23 @@ one ``Router``:
     stream across the reconnect (greedy decoding replays the identical
     prefix).
   * overload → HTTP semantics — typed ``RequestRejected`` reasons map to
-    distinct statuses: ``queue_full``/``overloaded`` → 429 (brownout's
-    ``overloaded`` tells clients to back off; both carry ``Retry-After``
-    derived from the autoscaler's cooldown — the earliest instant more
-    capacity could exist), ``no_healthy_replicas`` → 503, malformed
-    bodies / budget violations → 400, oversized bodies → 413.
+    distinct statuses: ``queue_full``/``overloaded``/``tenant_quota`` →
+    429 (brownout's ``overloaded`` tells clients to back off; all carry
+    ``Retry-After`` derived from the autoscaler's cooldown — the
+    earliest instant more capacity could exist), ``forbidden`` → 403,
+    ``no_healthy_replicas`` → 503, malformed bodies / budget violations
+    → 400, oversized bodies → 413.
+  * multi-tenant auth (docs/serving.md "Multi-tenant isolation") — with
+    ``serving.gateway.auth`` enabled every ``POST /v1/generate`` must
+    present ``Authorization: Bearer <token>``; the gateway hashes the
+    token and compares digests in constant time (raw tokens are never
+    stored, logged, journaled, or traced). Missing/malformed header →
+    401, unknown token → 403, per-tenant token bucket empty → 429 with
+    a per-tenant ``Retry-After``. The proven tenant id rides
+    ``Request.tenant`` into DWRR scheduling and quota accounting, and
+    scopes the idempotency map and SSE resume — one tenant can never
+    fetch or replay another's stream. ``/healthz`` and ``/metrics`` stay
+    unauthenticated (operational surface).
   * client disconnect → ``Router.cancel`` — a vanished or stalled reader
     is detected by the stream's next write (token events, or the ~1s
     keepalive comments an idle stream emits exactly so detection is
@@ -74,6 +86,8 @@ disconnect→cancel containment path the real events take.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
 import queue
 import socket
@@ -87,20 +101,93 @@ import numpy as np
 
 from ..resilience import FaultInjector, RequestRejected
 from ..resilience.preemption import PreemptionGuard
-from ..runtime.config import FaultInjectionConfig, GatewayConfig
+from ..runtime.config import (FaultInjectionConfig, GatewayAuthConfig,
+                              GatewayConfig)
 from ..telemetry import (RequestTracer, prometheus_fleet_text,
                          prometheus_text)
 from ..utils.logging import log_dist
 
 # RequestRejected reason -> HTTP status. 429 = the CLIENT should back off
 # and retry (capacity exists or is being added); 503 = the fleet itself
-# cannot serve (no healthy replica / shutting down).
+# cannot serve (no healthy replica / shutting down); 403 = the caller is
+# authenticated but not allowed to touch what it asked for.
 _REASON_STATUS = {
     "queue_full": 429,
     "overloaded": 429,
+    "tenant_quota": 429,
+    "forbidden": 403,
     "no_healthy_replicas": 503,
     "shutting_down": 503,
 }
+
+
+def _scoped_idem(tenant: str, key: str) -> str:
+    """Tenant-scoped idempotency-map key — mirrors
+    ``inference.router.tenant_idem_key`` (kept local: this module must
+    stay import-light, and the router's import chain pulls jax)."""
+    return f"{tenant}\x1f{key}" if tenant else str(key)
+
+
+class _TenantGate:
+    """Gateway-side tenant auth + token-bucket rate limiting
+    (docs/serving.md "Multi-tenant isolation"). Handler threads hit this
+    concurrently, so the bucket state carries its OWN lock — the Router
+    is never touched from here.
+
+    Secret hygiene: the config stores only SHA-256 digests; a presented
+    bearer token is hashed transiently and compared digest-to-digest with
+    ``hmac.compare_digest`` (constant-time). The raw token is never
+    stored on the gateway, never interpolated into an error message, and
+    never reaches a log line, journal record, trace event, or metric —
+    the ``secret-hygiene`` lint rule enforces this tree-wide."""
+
+    def __init__(self, auth: GatewayAuthConfig, clock=time.monotonic):
+        self.enabled = bool(auth.enabled)
+        self.tenants = dict(auth.tenants)  # tenant id -> TenantConfig
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._level = {t: float(tc.burst)
+                       for t, tc in self.tenants.items()}
+        self._stamp = {t: float(clock()) for t in self.tenants}
+
+    def authenticate(self, authorization: str | None) -> str:
+        """The tenant id the ``Authorization`` header proves, or ``""``
+        with auth disabled. Raises ``_HttpError``: 401 for a missing or
+        malformed header (unauthenticated), 403 for a well-formed token
+        that matches no tenant digest (unknown tenant)."""
+        if not self.enabled:
+            return ""
+        if not authorization or not authorization.startswith("Bearer "):
+            raise _HttpError(
+                401, "missing or malformed Authorization header "
+                     "(expected 'Bearer <token>')")
+        presented = authorization[len("Bearer "):].strip()
+        digest = hashlib.sha256(presented.encode("utf-8")).hexdigest()
+        for tid, tc in self.tenants.items():
+            if hmac.compare_digest(digest, tc.token_sha256):
+                return tid
+        raise _HttpError(403, "unknown tenant token")
+
+    def rate_admit(self, tenant: str) -> float:
+        """Consume one token from the tenant's bucket: 0.0 when admitted,
+        else the seconds until the NEXT bucket token exists — the
+        per-tenant ``Retry-After`` a 429 carries. Tenants without a
+        ``rate_rps`` limit always admit."""
+        tc = self.tenants.get(tenant)
+        if tc is None or tc.rate_rps <= 0:
+            return 0.0
+        with self._lock:
+            now = float(self._clock())
+            level = min(
+                float(tc.burst),
+                self._level.get(tenant, float(tc.burst))
+                + (now - self._stamp.get(tenant, now)) * tc.rate_rps)
+            self._stamp[tenant] = now
+            if level >= 1.0:
+                self._level[tenant] = level - 1.0
+                return 0.0
+            self._level[tenant] = level
+            return (1.0 - level) / tc.rate_rps
 
 
 class _Stream:
@@ -197,6 +284,20 @@ class HttpGateway:
         idem_map = getattr(router, "idempotency_map", None)
         if idem_map is not None:
             self._idem.update(idem_map())
+        # tenant auth + rate limiting (docs/serving.md "Multi-tenant
+        # isolation"). The gate is handler-thread state; the uid->tenant
+        # ownership map below is serve-loop-owned (same discipline as
+        # _idem) and backs the resume/fetch ownership check — a forged
+        # reconnect against another tenant's uid gets 403, never a stream.
+        self._gate = _TenantGate(self.cfg.auth)
+        self._uid_tenant: dict[int, str] = {}
+        if self._gate.enabled:
+            # the auth block doubles as the fleet's scheduling policy —
+            # install it on a router that was not configured with one, so
+            # one config block drives auth, DWRR weights, and quotas
+            setpol = getattr(router, "set_tenant_policy", None)
+            if setpol is not None and not getattr(router, "_tenants", None):
+                setpol(self._gate.tenants)
         self._draining = False
         self._stopped = False
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -315,7 +416,13 @@ class HttpGateway:
                 continue
             if op == "submit":
                 key = cmd.get("idem")
-                if key and self._replay_idempotent(cmd, key):
+                # the gateway's map (and the replay lookup) key by the
+                # TENANT-SCOPED composite; the router composes the same
+                # key itself at submit, so the raw client key crosses the
+                # submit boundary exactly once
+                skey = (_scoped_idem(cmd["request"].tenant, key)
+                        if key else None)
+                if skey and self._replay_idempotent(cmd, skey):
                     if cmd.get("abandoned") and cmd.get("fresh_stream"):
                         # the handler already 503'd and nobody else reads
                         # this feed: drop it (the REQUEST lives on — it
@@ -328,9 +435,12 @@ class HttpGateway:
                 try:
                     kw = {"idempotency_key": key} if key else {}
                     uid = self.router.submit(cmd["request"], **kw)
-                    if key:
+                    if skey:
                         # dstpu: allow[thread-race] -- _idem is serve-loop-owned state: every access sits in _drain_cmds/_replay_idempotent, which only the loop executes; the audit's {main, thread} role pair is the run()-inline vs start()-daemon duality — two alternative entries to the ONE loop thread, never both in one process
-                        self._idem[key] = uid
+                        self._idem[skey] = uid
+                    if cmd["request"].tenant:
+                        # dstpu: allow[thread-race] -- _uid_tenant is serve-loop-owned like _idem above: every access sits in _drain_cmds/_replay_idempotent, which only the loop executes; the audit's {main, thread} role pair is the run()-inline vs start()-daemon duality — two alternative entries to the ONE loop thread, never both in one process
+                        self._uid_tenant[uid] = cmd["request"].tenant
                     stream = _Stream(uid)
                     with self._lock:
                         self._streams[uid] = stream
@@ -370,7 +480,13 @@ class HttpGateway:
         existing stream (two concurrent retries share one feed, each with
         its own send cursor), or a fresh feed pre-filled from the fleet's
         progress cache / the journaled terminal result. False when the key
-        is unseen (the caller submits normally)."""
+        is unseen (the caller submits normally).
+
+        ``key`` is the TENANT-SCOPED composite, so another tenant's
+        identical client key can never resolve here; the explicit
+        ownership check below is defense in depth for the recovered/
+        legacy pools — a uid the requesting tenant does not own answers
+        403, never a stream."""
         uid = self._idem.get(key)
         if uid is None:
             lookup = getattr(self.router, "idempotency_lookup", None)
@@ -379,6 +495,21 @@ class HttpGateway:
             if uid is None:
                 return False
             self._idem[key] = uid
+        tenant = cmd["request"].tenant
+        owner = self._uid_tenant.get(uid)
+        if owner is None:
+            fn = getattr(self.router, "request_tenant", None)
+            owner = fn(uid) if fn is not None else None
+            if owner:
+                # dstpu: allow[thread-race] -- _uid_tenant is serve-loop-owned like _idem: only _drain_cmds/_replay_idempotent touch it and only the loop thread executes them; the flagged {main, thread} pair is the run()-inline vs start()-daemon duality, never both in one process
+                self._uid_tenant[uid] = owner
+        if owner and owner != tenant:
+            self.telemetry.counter("gateway/ownership_rejects").inc()
+            cmd["error"] = RequestRejected(
+                uid, "forbidden",
+                f"idempotency key does not belong to tenant {tenant!r}")
+            cmd["replayed"] = True
+            return True
         with self._lock:
             stream = self._streams.get(uid)
             if stream is None:
@@ -683,8 +814,13 @@ def _make_handler(gw: HttpGateway):
                 req, stream_mode, idem_key, resume_from = \
                     self._parse_generate()
             except _HttpError as e:
-                gw.telemetry.counter("gateway/bad_requests").inc()
-                self._reply_json(e.status, {"error": e.message})
+                if e.status in (401, 403):
+                    gw.telemetry.counter("gateway/auth_failures").inc()
+                elif e.status == 429:
+                    gw.telemetry.counter("gateway/rate_limited").inc()
+                else:
+                    gw.telemetry.counter("gateway/bad_requests").inc()
+                self._reply_json(e.status, {"error": e.message}, e.headers)
                 return
             with gw._lock:
                 draining = gw._draining
@@ -719,6 +855,10 @@ def _make_handler(gw: HttpGateway):
         # -- request parsing ---------------------------------------------
 
         def _parse_generate(self):
+            # auth FIRST (header-only): an unauthenticated caller learns
+            # nothing about body validation, and its request consumes no
+            # rate-limit budget
+            tenant = gw._gate.authenticate(self.headers.get("Authorization"))
             length = int(self.headers.get("Content-Length") or 0)
             if length <= 0:
                 raise _HttpError(400, "missing request body")
@@ -760,11 +900,29 @@ def _make_handler(gw: HttpGateway):
                     arrival_time=gw.router.now(),
                     deadline_s=deadline_s,
                     priority=priority,
+                    tenant=tenant,
                 )
             except (TypeError, ValueError) as e:
                 raise _HttpError(400, f"bad request field: {e}") from e
+            wait = gw._gate.rate_admit(tenant)
+            if wait > 0:
+                # token bucket empty: typed 429 with the PER-TENANT
+                # Retry-After — the instant this tenant's next bucket
+                # token exists, not a fleet-wide guess
+                gw.telemetry.counter(f"tenant/{tenant}/rate_limited").inc()
+                raise _HttpError(
+                    429, f"tenant {tenant!r} rate limit exceeded "
+                         f"(rate_rps={gw._gate.tenants[tenant].rate_rps})",
+                    headers={"Retry-After": max(1, int(wait) + 1)})
             idem_key = (self.headers.get("X-DSTPU-Idempotency-Key")
                         or "").strip() or None
+            if idem_key and any(ord(c) < 0x20 or c == "\x7f"
+                                for c in idem_key):
+                # control chars could forge the tenant-scoped composite
+                # key (the \x1f separator) — reject before any map touch
+                raise _HttpError(
+                    400, "X-DSTPU-Idempotency-Key must not contain "
+                         "control characters")
             resume_from = 0
             last_id = (self.headers.get("Last-Event-ID") or "").strip()
             if last_id:
@@ -935,10 +1093,12 @@ def _make_handler(gw: HttpGateway):
 
 
 class _HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: dict | None = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = headers or {}
 
 
 class _InjectedDisconnect(Exception):

@@ -1129,6 +1129,96 @@ class DisaggConfig:
 
 
 @dataclass
+class TenantConfig:
+    """One tenant under ``serving.gateway.auth.tenants`` (consumed by
+    ``launcher/http_gateway.HttpGateway`` + ``inference/router.Router`` +
+    ``inference/serving.ServingEngine``; docs/serving.md "Multi-tenant
+    isolation").
+
+    - ``token_sha256``: hex SHA-256 digest of the tenant's bearer token.
+      The RAW token never appears in config files the fleet journals or
+      snapshots — the gateway compares ``sha256(presented)`` against this
+      digest with a constant-time compare, so neither logs, journals,
+      traces nor ``/metrics`` can ever leak the credential.
+    - ``weight``: deficit-weighted-round-robin share of admission
+      bandwidth (relative to other tenants with queued work).
+    - ``max_queued``: per-tenant bound on arrived not-yet-admitted
+      requests across the fleet; past it submits bounce with a typed
+      ``RequestRejected(reason="tenant_quota")`` → HTTP 429. 0 =
+      unbounded (the tenant still competes under its DWRR weight).
+    - ``rate_rps`` / ``burst``: token-bucket rate limit at the gateway —
+      sustained requests/second and the bucket depth. ``rate_rps`` 0
+      disables the bucket.
+    """
+
+    token_sha256: str = ""
+    weight: float = 1.0
+    max_queued: int = 0
+    rate_rps: float = 0.0
+    burst: int = 8
+
+    def __post_init__(self):
+        if self.weight < 0.01:
+            raise DeepSpeedConfigError(
+                f"serving.gateway.auth tenant weight must be >= 0.01, "
+                f"got {self.weight}")
+        if self.max_queued < 0 or self.rate_rps < 0:
+            raise DeepSpeedConfigError(
+                "serving.gateway.auth tenant max_queued/rate_rps must be "
+                ">= 0")
+        if self.burst < 1:
+            raise DeepSpeedConfigError(
+                f"serving.gateway.auth tenant burst must be >= 1, "
+                f"got {self.burst}")
+        d = self.token_sha256
+        if d and (len(d) != 64 or any(c not in "0123456789abcdef"
+                                      for c in d.lower())):
+            raise DeepSpeedConfigError(
+                "serving.gateway.auth tenant token_sha256 must be a "
+                "64-char hex SHA-256 digest (never the raw token)")
+
+
+@dataclass
+class GatewayAuthConfig:
+    """``serving.gateway.auth`` block (docs/serving.md "Multi-tenant
+    isolation").
+
+    - ``enabled``: require ``Authorization: Bearer <token>`` on
+      ``POST /v1/generate``. Missing/malformed credentials → 401; a token
+      matching no tenant digest → 403. Off = every request is the
+      anonymous tenant ``""`` (the single-tenant behavior).
+    - ``tenants``: tenant id → ``TenantConfig`` (weight / quota / rate
+      limits keyed by the SHA-256 digest of each tenant's bearer token).
+      Tenant ids are plain printable identifiers (no control characters —
+      they ride metric names and journal records).
+    """
+
+    enabled: bool = False
+    tenants: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        coerced = {}
+        for tid, block in (self.tenants or {}).items():
+            if not tid or any(ord(c) < 0x20 or c == "\x7f" for c in tid):
+                raise DeepSpeedConfigError(
+                    f"serving.gateway.auth.tenants id {tid!r} must be a "
+                    f"non-empty string without control characters")
+            coerced[tid] = (_build(TenantConfig, block)
+                            if isinstance(block, dict) else block)
+        self.tenants = coerced
+        if self.enabled and not self.tenants:
+            raise DeepSpeedConfigError(
+                "serving.gateway.auth.enabled requires at least one "
+                "entry in serving.gateway.auth.tenants")
+        if self.enabled:
+            for tid, t in self.tenants.items():
+                if not t.token_sha256:
+                    raise DeepSpeedConfigError(
+                        f"serving.gateway.auth tenant {tid!r} needs a "
+                        f"token_sha256 digest when auth is enabled")
+
+
+@dataclass
 class GatewayConfig:
     """``serving.gateway`` block (consumed by
     ``launcher/http_gateway.HttpGateway``; docs/serving.md "HTTP front door
@@ -1162,6 +1252,8 @@ class GatewayConfig:
       per-replica labels (the loop owns the RPC sockets; handler threads
       only read the cache). 0 = off — ``/metrics`` exports the gateway's
       local registry only.
+    - ``auth``: multi-tenant bearer auth + fairness sub-block (its own
+      dataclass above; docs/serving.md "Multi-tenant isolation").
     """
 
     enabled: bool = False
@@ -1173,8 +1265,11 @@ class GatewayConfig:
     max_body_bytes: int = 1 << 20
     shutdown_grace_s: float = 30.0
     metrics_fleet_refresh_s: float = 0.0
+    auth: GatewayAuthConfig = field(default_factory=GatewayAuthConfig)
 
     def __post_init__(self):
+        if isinstance(self.auth, dict):
+            self.auth = _build(GatewayAuthConfig, self.auth)
         if not 0 <= self.port <= 65535:
             raise DeepSpeedConfigError(
                 f"serving.gateway.port must be in [0, 65535], got {self.port}")
@@ -1317,6 +1412,11 @@ class ServingConfig:
     - ``slot_quarantine_after``: consecutive NaN-logit faults in one slot
       after which that slot is pulled from rotation (suspected bad hardware
       lane); the last healthy slot is never quarantined.
+    - ``tenants``: tenant id → ``TenantConfig``-shaped block (``weight`` /
+      ``max_queued``; the auth fields are gateway-side and ignored here).
+      Drives the engine scheduler's deficit-weighted round-robin admission
+      and per-tenant queue caps (docs/serving.md "Multi-tenant
+      isolation"). Empty = single-tenant FIFO-equivalent behavior.
     """
 
     n_slots: int = 8
@@ -1329,6 +1429,7 @@ class ServingConfig:
     default_deadline_s: float = 0.0  # 0 = no deadline
     quarantine_max_requeues: int = 1
     slot_quarantine_after: int = 2
+    tenants: dict = field(default_factory=dict)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     chunked_prefill: ChunkedPrefillConfig = field(default_factory=ChunkedPrefillConfig)
     speculation: SpeculationConfig = field(default_factory=SpeculationConfig)
@@ -1346,6 +1447,11 @@ class ServingConfig:
     jsonl_keep: int = 3
 
     def __post_init__(self):
+        if isinstance(self.tenants, dict):
+            self.tenants = {
+                tid: (_build(TenantConfig, block)
+                      if isinstance(block, dict) else block)
+                for tid, block in self.tenants.items()}
         if isinstance(self.prefix_cache, dict):
             self.prefix_cache = _build(PrefixCacheConfig, self.prefix_cache)
         if isinstance(self.chunked_prefill, dict):

@@ -416,6 +416,60 @@ def summarize(events: list[dict], top: int = 10) -> str:
                 f"verify_steps={rsp.get('verify_steps', 0)}")
         lines.append("")
 
+    # -- per-tenant isolation --------------------------------------------
+    # policy vs accounting per tenant (docs/serving.md "Multi-tenant
+    # isolation"): DWRR weight/quota and live load from router_stats,
+    # counters and latency percentiles aggregated over the router registry
+    # plus every replica engine registry (tenant/<id>/* names)
+    tens = (rt.get("tenants") if rt else None) or {}
+    tregs = [m for m in
+             ([rt.get("metrics", {})] if rt else [])
+             + [rep.get("metrics", {}) for rep in
+                ((snap.get("replicas") or {}).values() if snap else ())]
+             if m]
+    tids = set(tens)
+    for m in tregs:
+        for kind in ("counters", "gauges", "histograms"):
+            for name in m.get(kind, {}):
+                if name.startswith("tenant/"):
+                    tids.add(name.split("/", 2)[1])
+    if tids:
+        def _tsum(kind, tid, metric):
+            return sum(m.get(kind, {}).get(f"tenant/{tid}/{metric}", 0)
+                       for m in tregs)
+
+        def _tp(tid, metric, q):
+            # worst-replica percentile: exact cross-replica merge would
+            # need the raw buckets, and the conservative bound is what an
+            # isolation drill asserts against anyway
+            return max((m.get("histograms", {})
+                        .get(f"tenant/{tid}/{metric}", {}).get(q, 0.0)
+                        for m in tregs), default=0.0)
+
+        lines.append(f"per-tenant isolation ({len(tids)} tenants):")
+        lines.append(
+            f"  {'tenant':<12} {'weight':>6} {'quota':>5} {'live':>5} "
+            f"{'req':>6} {'rej':>5} {'shed':>5} {'429':>5} "
+            f"{'slo ok/miss':>12} {'ttft p50/p99':>17} {'q':>4} {'slots':>5}")
+        for tid in sorted(tids):
+            pol = tens.get(tid, {})
+            flag = "  <-- over quota" if pol.get("over_quota") else ""
+            slo_cell = (f"{_tsum('counters', tid, 'slo_ok'):g}/"
+                        f"{_tsum('counters', tid, 'slo_miss'):g}")
+            ttft_cell = (f"{_fmt_s(_tp(tid, 'ttft_sec', 'p50'))}/"
+                         f"{_fmt_s(_tp(tid, 'ttft_sec', 'p99'))}")
+            lines.append(
+                f"  {tid:<12} {pol.get('weight', 1.0):>6g} "
+                f"{pol.get('max_queued', 0):>5} {pol.get('live', 0):>5} "
+                f"{_tsum('counters', tid, 'requests'):>6g} "
+                f"{_tsum('counters', tid, 'rejected'):>5g} "
+                f"{_tsum('counters', tid, 'sheds'):>5g} "
+                f"{_tsum('counters', tid, 'rate_limited'):>5g} "
+                f"{slo_cell:>12} {ttft_cell:>17} "
+                f"{_tsum('gauges', tid, 'queued'):>4g} "
+                f"{_tsum('gauges', tid, 'slots'):>5g}{flag}")
+        lines.append("")
+
     # -- autoscaler -----------------------------------------------------
     # the elasticity loop's decision ring (inference/autoscaler.py):
     # target/brownout state plus the typed scale/respawn/brownout events,

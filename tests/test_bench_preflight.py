@@ -195,6 +195,21 @@ def test_router_chaos_argv_contract_exits_2_with_usage(argv):
 
 
 @pytest.mark.parametrize("argv", [
+    ("--tenant-chaos", "7"),                      # unexpected operand
+    ("--tenant-chaos", "--tenant-seed", "xyz"),   # non-numeric seed
+    ("--tenant-chaos", "--tenant-seed"),          # dangling seed flag
+])
+def test_tenant_chaos_argv_contract_exits_2_with_usage(argv):
+    """``--tenant-chaos`` follows the sibling-drill contract: malformed
+    operands exit 2 with a usage line on stderr — never a traceback,
+    never a started drill."""
+    proc = _run_bench_argv(*argv)
+    assert proc.returncode == 2, (argv, proc.stderr)
+    assert "usage: bench.py --tenant-chaos" in proc.stderr
+    assert "Traceback" not in proc.stderr
+
+
+@pytest.mark.parametrize("argv", [
     ("--disagg", "7"),                      # unexpected operand
     ("--disagg", "--disagg-seed", "xyz"),   # non-numeric seed
     ("--disagg", "--disagg-seed"),          # dangling seed flag
@@ -219,7 +234,12 @@ def test_drill_rows_carry_the_stamp_contract(bench):
     assert stamp == {"platform": "cpu", "comparable": False, "mfu": None,
                      "roofline": "unrated:cpu", "step_anatomy": None,
                      "spec_acceptance_rate": None,
-                     "spec_tokens_per_sec_per_request_ratio": None}
+                     "spec_tokens_per_sec_per_request_ratio": None,
+                     # tenant-isolation stamps: labeled nulls on every
+                     # non-tenant drill row (--tenant-chaos fills them)
+                     "tenant_victim_ttft_p99_ratio": None,
+                     "tenant_victim_sheds": None,
+                     "tenant_aggressor_429s": None}
     # the stamp agrees with what _stamp_row would enforce on a cpu row
     stamped = bench._stamp_row(dict(stamp), "drill")
     assert stamped["comparable"] is False

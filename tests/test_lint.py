@@ -49,6 +49,7 @@ def test_registry_has_the_shipped_rules():
                 "unguarded-donation", "rename-durability",
                 "append-durability",
                 "socket-discipline", "unlogged-collective",
+                "secret-hygiene",
                 "config-doc-drift", "metric-doc-drift",
                 "pragma", "parse-error"}
     assert expected <= set(RULES)
@@ -579,6 +580,68 @@ def test_append_durability_pragma_with_rationale_suppresses(tmp_path):
     """})
     res = run_lint(pkg, rule_ids=["append-durability"])
     assert not findings_for(res, "append-durability")
+    assert res.suppressed
+
+
+# ---------------------------------------------------------------------------
+# secret-hygiene
+
+
+def test_secret_hygiene_flags_credentials_at_every_sink_kind(tmp_path):
+    pkg = make_tree(tmp_path, {"launcher/x.py": """\
+        def leak(tm, tracer, journal, req, token, api_key, cfg):
+            print("auth failed for", token)                  # log sink
+            tm.counter(f"gateway/{api_key}/hits").inc()      # metric name
+            tracer.record(req.uid, "auth", secret=cfg.secret)  # trace kwarg
+            journal.record_submit(req, token=token)          # journal kwarg
+            tm.emit({"token": token})                        # JSONL dict key
+            log_dist(f"bearer={cfg.authorization}")          # attr in fstring
+    """})
+    res = run_lint(pkg, rule_ids=["secret-hygiene"])
+    found = findings_for(res, "secret-hygiene")
+    assert len(found) >= 6
+    assert all("credential-named" in f.message for f in found)
+
+
+def test_secret_hygiene_vocab_token_telemetry_is_clean(tmp_path):
+    # this codebase says "token" for VOCAB ids everywhere — plural and
+    # affixed spellings (tokens_sent, eos_token_id, n_tokens) must never
+    # flag, and neither may non-sink writes like SSE frames
+    pkg = make_tree(tmp_path, {"inference/x.py": """\
+        def report(tm, tracer, uid, tokens_sent, eos_token_id, n, tok, w):
+            print("sent", tokens_sent, "eos", eos_token_id)
+            tm.counter("serving/tokens_out").inc(n)
+            tm.emit({"n_tokens": n, "tokens": [tok]})
+            tracer.record(uid, "decode", tokens=n)
+            w.write(json.dumps({"token": tok}))  # SSE frame, not a sink
+    """})
+    res = run_lint(pkg, rule_ids=["secret-hygiene"])
+    assert not findings_for(res, "secret-hygiene")
+
+
+def test_secret_hygiene_digest_wrapped_access_is_exempt(tmp_path):
+    # hashing the credential before export is the sanctioned spelling —
+    # both a digest call around the secret and a *_sha256 attribute pass
+    pkg = make_tree(tmp_path, {"launcher/x.py": """\
+        import hashlib
+        def audit(tm, tracer, uid, token, tc):
+            d = hashlib.sha256(token.encode()).hexdigest()
+            log_dist("token digest=%s" % d)
+            tracer.record(uid, "auth_ok", token_sha256=tc.token_sha256)
+            tm.emit({"digest": hashlib.sha256(token.encode()).hexdigest()})
+    """})
+    res = run_lint(pkg, rule_ids=["secret-hygiene"])
+    assert not findings_for(res, "secret-hygiene")
+
+
+def test_secret_hygiene_pragma_with_rationale_suppresses(tmp_path):
+    pkg = make_tree(tmp_path, {"x.py": """\
+        def f(token):
+            # dstpu: allow[secret-hygiene] -- vocab token id, not a credential
+            print("next token", token)
+    """})
+    res = run_lint(pkg, rule_ids=["secret-hygiene"])
+    assert not findings_for(res, "secret-hygiene")
     assert res.suppressed
 
 
