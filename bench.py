@@ -313,9 +313,12 @@ def _fault_smoke(rate: float) -> int:
     counters = snap["metrics"]["counters"]
     gauges = snap["metrics"]["gauges"]
 
-    # -- the resilience contract, asserted ---------------------------------
-    missing = [r.uid for r in reqs if r.uid not in results]
-    assert not missing, f"requests never reached a terminal status: {missing}"
+    # -- the resilience contract, via the shared oracle library ------------
+    from deepspeed_tpu.resilience.invariants import (
+        check, occupancy_drained, occupancy_view, single_decode_program,
+        zero_accepted_loss)
+
+    check(zero_accepted_loss([r.uid for r in reqs], results))
     recovered = counters.get("resilience/recovered", 0)
     injected = counters.get("resilience/injected_faults", 0)
     assert injected > 0, (
@@ -324,14 +327,12 @@ def _fault_smoke(rate: float) -> int:
     assert recovered > 0, (
         "faults were injected but no quarantined request recovered "
         f"(counters: { {k: v for k, v in counters.items() if 'resil' in k} })")
-    # no slot leak: engine idle, occupancy gauge back to 0, and every
-    # non-quarantined slot back in the free pool
-    assert srv.n_active == 0 and srv.n_prefilling == 0
+    # no slot leak: engine drained, occupancy gauge back to 0, decode
+    # never retraced — the occupancy oracle covers active/prefilling/queue
+    # and the free+quarantined==slots accounting
+    check(occupancy_drained([occupancy_view(srv, name="srv")]))
     assert gauges.get("serving/active_slots", -1) == 0, gauges
-    assert srv.n_free + len(srv.quarantined_slots) == srv.n_slots, (
-        f"slot leak: {srv.n_free} free + {len(srv.quarantined_slots)} "
-        f"quarantined != {srv.n_slots}")
-    assert srv.compile_counts()["decode"] == 1, "decode retraced under faults"
+    check(single_decode_program({"srv": srv.compile_counts()["decode"]}))
 
     from collections import Counter as _Counter
 
@@ -464,14 +465,18 @@ def _chaos(steps: int, seed: int) -> int:
         survivor_steps = steps
 
     # -- the elastic contract, asserted ------------------------------------
+    from deepspeed_tpu.resilience.invariants import Violation, check
+
     assert tallies["preemptions"] >= 2, tallies
     assert tallies["resumes"] >= 2, tallies
     assert tallies["ckpt_retries"] >= 1, (
         "the io_flaky transient write was never retried", tallies)
     assert tallies["nan_skipped_steps"] >= 1, tallies
-    assert final_loss == clean_loss, (
+    # training-side spelling of the parity oracle: one scalar, same name
+    check([] if final_loss == clean_loss else [Violation(
+        "bitwise_parity_vs_reference",
         f"survivor final-step loss {final_loss!r} != clean run "
-        f"{clean_loss!r} — resume is not bitwise")
+        f"{clean_loss!r} — resume is not bitwise")])
 
     print(json.dumps({
         "metric": "chaos soak drill (injected faults survived)",
@@ -610,18 +615,21 @@ def _chaos_serving(seed: int) -> int:
         sup.kill(rid_to_slot[victim_decode], signal.SIGKILL)
         drive_until_terminal(list(submitted))
 
-        # -- the fleet contract, asserted ---------------------------------
-        missing = sorted(submitted - set(router.results))
-        assert not missing, f"no terminal state for {missing}"
+        # -- the fleet contract, via the shared oracle library ------------
+        from deepspeed_tpu.resilience.invariants import (
+            bitwise_parity_vs_reference, check, exactly_once_failover,
+            single_decode_program, zero_accepted_loss)
+
+        check(zero_accepted_loss(submitted, router.results))
         bad_status = {u: router.results[u].status for u in submitted
                       if not router.results[u].ok}
         assert not bad_status, f"non-ok terminals: {bad_status}"
-        for u in submitted:
-            np.testing.assert_array_equal(
-                router.results[u].tokens, ref[u],
-                err_msg=f"uid {u} diverged from the unfaulted run")
+        check(bitwise_parity_vs_reference(
+            {u: router.results[u] for u in submitted}, ref,
+            uids=sorted(submitted), statuses=None,
+            min_compared=len(submitted)))
         stats = router.router_stats()
-        assert stats["failovers_recovered"] >= 2, stats
+        check(exactly_once_failover(stats, min_recovered=2))
 
         # -- supervisor respawn within the backoff budget -----------------
         t_respawn = time.monotonic()
@@ -646,9 +654,10 @@ def _chaos_serving(seed: int) -> int:
                        if r > 2]  # attached after the kills
         assert any(router.owner_of(u) in rookie_rids for u in (9, 10, 11))
         drive_until_terminal([9, 10, 11])
-        for u in (9, 10, 11):
-            assert router.results[u].ok
-            np.testing.assert_array_equal(router.results[u].tokens, ref[u])
+        # min_compared forces all three to be ok-status AND bit-equal
+        check(bitwise_parity_vs_reference(
+            {u: router.results[u] for u in (9, 10, 11)}, ref,
+            uids=(9, 10, 11), min_compared=3))
 
         # -- merged snapshot attribution + watchdog-raise inventory -------
         snap = router.telemetry_snapshot()
@@ -668,7 +677,7 @@ def _chaos_serving(seed: int) -> int:
             if state == "dead":
                 continue
             decode_compiles[r] = router._replicas[r].engine.compile_counts()["decode"]
-        assert all(v == 1 for v in decode_compiles.values()), decode_compiles
+        check(single_decode_program(decode_compiles))
 
         rpc_totals = {}
         for r in router._replicas:
@@ -741,6 +750,9 @@ def _disagg_drill(seed: int) -> int:
     from deepspeed_tpu.inference.serving import Request, ServingEngine
     from deepspeed_tpu.launcher.serving_worker import WorkerSupervisor
     from deepspeed_tpu.models.transformer import Model, TransformerConfig
+    from deepspeed_tpu.resilience.invariants import (
+        bitwise_parity_vs_reference, check, exactly_once_failover,
+        zero_accepted_loss)
 
     t0 = time.perf_counter()
     serving_cfg = {
@@ -782,11 +794,12 @@ def _disagg_drill(seed: int) -> int:
         t_dis = time.perf_counter()
         out = dis.drain()
         t_dis = time.perf_counter() - t_dis
-        for i in range(6):
-            assert ref[i].ok and out[i].ok, (leg, i, out[i].status)
-            np.testing.assert_array_equal(
-                ref[i].tokens, out[i].tokens,
-                err_msg=f"leg {leg}: uid {i} diverged across the handoff")
+        assert all(ref[i].ok and out[i].ok for i in range(6)), (
+            leg, {i: out[i].status for i in range(6)})
+        # shared parity oracle: role-split output must be bit-identical
+        # to the co-located fleet's (min_compared pins all six)
+        check(bitwise_parity_vs_reference(
+            out, ref, uids=range(6), min_compared=6))
         st = dis.router_stats()
         assert st["disagg"]["handoffs"] == 6, (leg, st["disagg"])
         if leg == "base":
@@ -877,21 +890,19 @@ def _disagg_drill(seed: int) -> int:
             router.step(now=0.0)
             if all(100 + i in router.results for i in range(6)):
                 break
-        missing = [100 + i for i in range(6)
-                   if 100 + i not in router.results]
-        assert not missing, f"accepted requests lost: {missing}"
+        check(zero_accepted_loss([100 + i for i in range(6)],
+                                 router.results))
         bad = {u: router.results[u].status for u in ref
                if not router.results[u].ok}
         assert not bad, f"non-ok terminals: {bad}"
-        for u in ref:
-            np.testing.assert_array_equal(
-                router.results[u].tokens, ref[u],
-                err_msg=f"uid {u} diverged after the mid-handoff kill")
+        check(bitwise_parity_vs_reference(
+            router.results, ref, uids=sorted(ref), statuses=None,
+            min_compared=len(ref)))
         assert kill_state["victim"] is not None, "kill never fired"
         victim_rid = kill_state["victim"]  # slot == rid at boot
         stats = router.router_stats()
         assert router.replica_states()[victim_rid] == "dead"
-        assert stats["failovers_recovered"] >= 1, stats
+        check(exactly_once_failover(stats, min_recovered=1))
         assert stats["disagg"]["handoffs"] == 6, stats["disagg"]
         hist = router.telemetry.registry.snapshot()["histograms"]
         handoff_sec = hist.get("router/disagg/handoff_sec", {})
@@ -1100,18 +1111,20 @@ def _surge(n_requests: int, seed: int) -> int:
         assert asc_c.get("brownouts", 0) >= 1, (
             "the saturated-at-max window never browned out", asc_c)
         assert healthy_n() == 1 and asc.target == 1
-        missing = sorted(submitted - set(router.results))
-        assert not missing, f"accepted requests without a terminal state: {missing}"
+        from deepspeed_tpu.resilience.invariants import (
+            bitwise_parity_vs_reference, check, single_decode_program,
+            zero_accepted_loss)
+
+        check(zero_accepted_loss(submitted, router.results))
         ok_uids = [u for u in submitted if router.results[u].ok]
-        for u in ok_uids:
-            np.testing.assert_array_equal(
-                router.results[u].tokens, ref[u],
-                err_msg=f"uid {u} diverged from the unfaulted run")
+        check(bitwise_parity_vs_reference(
+            router.results, ref, uids=ok_uids, statuses=None,
+            min_compared=len(ok_uids)))
         # watchdog RAISE held on every reachable worker: ONE decode program
-        for rid, state in router.replica_states().items():
-            if state == "healthy":
-                assert router._replicas[rid].engine.compile_counts()[
-                    "decode"] == 1, rid
+        check(single_decode_program(
+            {rid: router._replicas[rid].engine.compile_counts()["decode"]
+             for rid, state in router.replica_states().items()
+             if state == "healthy"}))
 
         from collections import Counter as _Counter
 
@@ -1432,9 +1445,14 @@ def _gateway_chaos(seed: int) -> int:
         assert state["respawns"] >= 1, "the corpse was never recovered"
         # zero accepted-request loss: every uid the gateway accepted is
         # terminal; disconnected streams terminate cancelled
-        missing = [u for u in accepted if router.result(u) is None]
-        assert not missing, f"accepted uids without a terminal state: {missing}"
-        statuses = {u: router.result(u).status for u in accepted}
+        from deepspeed_tpu.resilience.invariants import (
+            bitwise_parity_vs_reference, check, occupancy_drained,
+            occupancy_view, single_decode_program, zero_accepted_loss)
+
+        terminals = {u: router.result(u) for u in accepted
+                     if router.result(u) is not None}
+        check(zero_accepted_loss(accepted, terminals))
+        statuses = {u: terminals[u].status for u in accepted}
         disconnected_uids = [outcomes[i]["uid"] for i in disconnect_after
                              if outcomes[i].get("uid") is not None
                              and "disconnected_at" in outcomes[i]]
@@ -1444,20 +1462,20 @@ def _gateway_chaos(seed: int) -> int:
         assert cancelled, (
             "no vanished reader was cancelled fleet-side", statuses)
         # bitwise greedy parity on completed requests vs the unfaulted run
-        parity_checked = 0
-        for u, i in accepted.items():
-            res = router.result(u)
-            if res.status != "ok":
-                continue
-            np.testing.assert_array_equal(
-                res.tokens, ref[i],
-                err_msg=f"uid {u} (client {i}) diverged from the "
-                        f"unfaulted run")
+        # (reference re-keyed uid -> clean tokens via the client index);
+        # min_compared guards the vacuous-green case the old hand-rolled
+        # parity_checked >= 6 assert covered
+        ok_uids = [u for u, st_u in statuses.items() if st_u == "ok"]
+        check(bitwise_parity_vs_reference(
+            terminals, {u: ref[i] for u, i in accepted.items()},
+            uids=ok_uids, statuses=None, min_compared=6))
+        for u in ok_uids:
+            i = accepted[u]
             done_ev = outcomes[i].get("done")
             if done_ev is not None:
                 assert done_ev["tokens"] == [int(t) for t in ref[i]], (
                     "SSE-streamed tokens diverged", i)
-            parity_checked += 1
+        parity_checked = len(ok_uids)
         assert parity_checked >= 6, (
             f"only {parity_checked} completed requests to compare",
             statuses)
@@ -1470,15 +1488,12 @@ def _gateway_chaos(seed: int) -> int:
         # watchdog RAISE held (ONE decode program per reachable worker)
         live = [r for r in router._replicas if r.state == "healthy"]
         assert live, router.replica_states()
-        for r in live:
-            assert r.engine.load == 0, (r.rid, r.engine.load)
-            # raise-mode held: ONE decode program ever (a post-upgrade
-            # rookie that saw no traffic has 0 — never 2)
-            assert r.engine.compile_counts()["decode"] <= 1, r.rid
-            pstats = r.engine.prefix_cache_stats()
-            leaked = [e for e in (pstats or {}).get("entries", [])
-                      if e.get("refs")]
-            assert not leaked, (r.rid, leaked)
+        check(occupancy_drained(
+            occupancy_view(r.engine, name=r.rid) for r in live))
+        # raise-mode held: ONE decode program ever (a post-upgrade rookie
+        # that saw no traffic has 0 — never 2)
+        check(single_decode_program(
+            {r.rid: r.engine.compile_counts()["decode"] for r in live}))
 
         # -- flight recorder: the SIGKILL left an autopsy bundle ----------
         # the dead verdict staged replica_dead, the failover storm
@@ -1965,20 +1980,27 @@ def _router_chaos(seed: int) -> int:
         assert rec.get("router/recovery/recoveries") == 1, rec
         assert rec.get("router/recovery/adopted_requests", 0) >= 1, rec
         # zero accepted-request loss + bitwise parity on EVERY completion
+        from deepspeed_tpu.resilience.invariants import (
+            bitwise_parity_vs_reference, check)
+
         for i, out in outcomes.items():
             assert out["done"] is not None, (i, out)
             assert out["done"]["status"] == "ok", (i, out["done"])
             assert len(out["uids"]) == 1, (
                 "a retried idempotency key forked a uid", i, out["uids"])
-            if i in blocking:
-                assert out["done"]["tokens"] == ref[i], (
-                    "blocking-mode tokens diverged", i)
-            else:
+        # every client's terminal token list vs the unfaulted reference
+        # (keys are client indices; the oracle reads bare lists)
+        check(bitwise_parity_vs_reference(
+            {i: out["done"]["tokens"] for i, out in outcomes.items()},
+            ref, uids=sorted(outcomes), statuses=None,
+            min_compared=len(outcomes)))
+        for i, out in outcomes.items():
+            if i not in blocking:
+                # streamed-event continuity: every id present, in order
                 n = len(ref[i])
                 toks = [out["tokens"].get(k) for k in range(n)]
                 assert toks == ref[i], (
                     "streamed tokens diverged/gapped", i, toks, ref[i])
-                assert out["done"]["tokens"] == ref[i], i
         resumed = [i for i, o in outcomes.items() if o["resumed"]]
         assert resumed, "no SSE stream resumed across the restart"
         for i in resumed:
@@ -1993,7 +2015,8 @@ def _router_chaos(seed: int) -> int:
         # occupancy back to 0, watchdog RAISE held, prefix refs clean
         assert final["loads"] and all(
             v == 0 for v in final["loads"].values()), final["loads"]
-        assert all(v <= 1 for v in final["decode_compiles"].values()), final
+        from deepspeed_tpu.resilience.invariants import single_decode_program
+        check(single_decode_program(final["decode_compiles"]))
         assert all(not v for v in final["prefix_leaks"].values()), final
         assert final["counters"].get("gateway/resumed_streams", 0) >= 1, (
             final["counters"])
@@ -2448,6 +2471,10 @@ def _tenant_chaos(seed: int) -> int:
         assert final is not None, "restarted child printed no final stats"
 
         # -- the isolation contract, asserted ----------------------------
+        from deepspeed_tpu.resilience.invariants import (
+            bitwise_parity_vs_reference, check, no_raw_secret_in_artifacts,
+            single_decode_program)
+
         # victim: every request ok, bitwise-identical to the reference
         for i in sorted(vic_cont):
             out = cont_out[i]
@@ -2459,6 +2486,11 @@ def _tenant_chaos(seed: int) -> int:
             toks = [out["tokens"].get(k) for k in range(n)]
             assert toks == ref[("cont", i)], (
                 "victim tokens diverged (cross-tenant contamination?)", i)
+        check(bitwise_parity_vs_reference(
+            {i: cont_out[i]["done"]["tokens"] for i in vic_cont},
+            {i: ref[("cont", i)] for i in vic_cont},
+            uids=sorted(vic_cont), statuses=None,
+            min_compared=len(vic_cont)))
         # victim p99 TTFT bounded vs solo. The factor + floor budget the
         # CPU smoke's worst case — router + 2 workers + 13 client threads
         # timesharing as little as ONE core, where even a perfectly
@@ -2494,19 +2526,18 @@ def _tenant_chaos(seed: int) -> int:
         # requests adopted) + program count flat under the tenant mix
         rec = ready2["recovery"]
         assert rec.get("router/recovery/recoveries") == 1, rec
-        assert all(v <= 1 for v in final["decode_compiles"].values()), final
+        check(single_decode_program(final["decode_compiles"]))
         assert final["loads"] and all(
             v == 0 for v in final["loads"].values()), final["loads"]
         # secret hygiene end to end: no raw bearer token in the journal
-        # or either child log (digests only)
-        with open(journal, "rb") as f:
-            jbytes = f.read()
-        for raw in (vic_tok, agg_tok):
-            assert raw.encode() not in jbytes, "raw token in the journal"
-            for lp in (log1, log2):
-                with open(lp, "rb") as f:
-                    assert raw.encode() not in f.read(), (
-                        "raw token in child log", lp)
+        # or either child log (digests only) — the oracle reports secrets
+        # by index, never by content
+        artifacts = {}
+        for name, lp in (("journal", journal), ("log1", log1),
+                         ("log2", log2)):
+            with open(lp, "rb") as f:
+                artifacts[name] = f.read()
+        check(no_raw_secret_in_artifacts(artifacts, (vic_tok, agg_tok)))
 
         resumed = [i for i, o in cont_out.items() if o["resumed"]]
         print(json.dumps({
@@ -2554,6 +2585,75 @@ def _tenant_chaos(seed: int) -> int:
                         pass
         except OSError:
             pass
+
+
+def _chaos_search(n_schedules: int, seed: int) -> int:
+    """Seeded fault-space search (``bench.py --chaos-search``): run
+    ``n_schedules`` generated ``FaultSchedule``s against the shared
+    invariant suite over the host-only fake fleet
+    (``resilience/chaos.py``). Every violation is delta-debugged to a
+    minimal reproducer written rename-durably to
+    ``chaos-repros/chaos-repro-NNN.json`` — re-execute one bit-identically
+    with ``--chaos-replay FILE``. Exit 0 only when every schedule is
+    green. CPU-pinned, in-process, zero XLA programs — a correctness
+    search, never a perf number."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from deepspeed_tpu.resilience.chaos import ChaosRunner, search
+
+    t0 = time.perf_counter()
+    runner = ChaosRunner()
+    row = search(
+        runner, n_schedules, seed,
+        artifact_dir=os.path.join(os.getcwd(), "chaos-repros"),
+        log=lambda m: print(f"chaos-search: {m}", file=sys.stderr,
+                            flush=True))
+    counters = runner.telemetry.registry.snapshot()["counters"]
+    site_fired = {s: int(counters.get(f"chaos/site/{s}/fired", 0))
+                  for s in row["sites_covered"]}
+    print(json.dumps({
+        "metric": "chaos fault-space search (green schedules)",
+        "value": int(row["schedules_run"]) - len(row["violations"]),
+        "unit": "schedules",
+        # CPU-pinned correctness search: never a trajectory datapoint
+        **_drill_stamp(),
+        "schedules_run": row["schedules_run"],
+        "sites_covered": row["sites_covered"],
+        "site_fired": site_fired,
+        "violations": row["violations"],
+        "seed": seed,
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }), flush=True)
+    return 1 if row["violations"] else 0
+
+
+def _chaos_replay(path: str) -> int:
+    """Replay one ``chaos-repro-NNN.json`` (``bench.py --chaos-replay``)
+    and verify bit-identical reproduction: the re-run must produce the
+    SAME outcome digest and trip the SAME invariant set the artifact
+    recorded. Also accepts a bare schedule JSON (replays without the
+    digest comparison)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from deepspeed_tpu.resilience.chaos import ChaosRunner, replay_repro
+
+    t0 = time.perf_counter()
+    with open(path) as f:
+        repro = json.load(f)
+    rep = replay_repro(ChaosRunner(), repro)
+    ok = bool(rep["digest_match"] and rep["violations_match"])
+    print(json.dumps({
+        "metric": "chaos repro replay (bit-identical)",
+        "value": int(ok),
+        "unit": "bool",
+        # CPU-pinned correctness replay: never a trajectory datapoint
+        **_drill_stamp(),
+        "repro": os.path.basename(path),
+        "digest": rep["digest"],
+        "digest_match": rep["digest_match"],
+        "tripped": rep["tripped"],
+        "violations_match": rep["violations_match"],
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }), flush=True)
+    return 0 if ok else 1
 
 
 def _drill_stamp():
@@ -2873,6 +2973,40 @@ if __name__ == "__main__":
                   file=sys.stderr)
             sys.exit(2)
         sys.exit(_disagg_drill(dg_seed))
+    if "--chaos-replay" in sys.argv:
+        # usage-error exit 2 on malformed values (same contract as
+        # --chaos/--chaos-serving/--chaos-search)
+        try:
+            idx = sys.argv.index("--chaos-replay")
+            if idx + 1 >= len(sys.argv) or sys.argv[idx + 1].startswith("--"):
+                raise ValueError("missing FILE operand")
+            repro_path = sys.argv[idx + 1]
+        except (IndexError, ValueError) as e:
+            print(f"usage: bench.py --chaos-replay <chaos-repro.json> ({e})",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_chaos_replay(repro_path))
+    if "--chaos-search" in sys.argv:
+        # usage-error exit 2 on malformed values (same contract as
+        # --chaos/--chaos-serving/--surge)
+        try:
+            idx = sys.argv.index("--chaos-search")
+            cs_n = 64
+            if idx + 1 < len(sys.argv) and not sys.argv[idx + 1].startswith("--"):
+                # "--"-prefixed means the next FLAG; a bare "-3" is a (bad)
+                # operand and must hit the usage check, not be ignored
+                cs_n = int(sys.argv[idx + 1])
+            cs_seed = 0
+            if "--chaos-search-seed" in sys.argv:
+                cs_seed = int(
+                    sys.argv[sys.argv.index("--chaos-search-seed") + 1])
+            if cs_n < 1:
+                raise ValueError("n_schedules must be >= 1")
+        except (IndexError, ValueError) as e:
+            print(f"usage: bench.py --chaos-search [n_schedules >= 1] "
+                  f"[--chaos-search-seed <int>] ({e})", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_chaos_search(cs_n, cs_seed))
     if "--chaos-serving" in sys.argv:
         # usage-error exit 2 on malformed values (same contract as --chaos)
         try:

@@ -79,7 +79,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..resilience import JournalCorruptError
+from ..resilience import JournalCorruptError, JournalUnavailableError
 from ..utils.durability import fsync_dir
 from ..utils.logging import logger
 from .rpc import encode_request, encode_result
@@ -204,16 +204,30 @@ class RequestJournal:
 
     ``telemetry`` (optional): ``router/journal/appends`` and
     ``router/journal/rotations`` counters.
+
+    Write-failure policy is FAIL-CLOSED: an append that cannot reach disk
+    (ENOSPC, a failed fsync, or the injected ``io_error_journal_appends``
+    key via ``injector``) marks the journal ``unavailable`` and raises a
+    typed ``JournalUnavailableError`` — every later append refuses
+    immediately with the same error. The in-memory mirror is applied only
+    AFTER the frame is durably written, so on failure mirror == durable
+    file exactly and a restart over the same path replays precisely what
+    clients were promised. The accept path converts the error into a
+    ``journal_unavailable`` rejection (503); un-journalable TERMINAL
+    records are counted and incident-triggered but never crash the serve
+    loop (the restart re-derives them from the workers).
     """
 
     def __init__(self, path: str, *, fsync: bool = True,
                  rotate_max_records: int = 4096, keep_terminals: int = 1024,
-                 telemetry=None):
+                 telemetry=None, injector=None):
         self.path = str(path)
         self.fsync = bool(fsync)
         self.rotate_max_records = int(rotate_max_records)
         self.keep_terminals = int(keep_terminals)
         self._tm = telemetry
+        self._inj = injector
+        self.unavailable = False
         self.state = replay(self.path)
         self.recovered = bool(self.state.requests or self.state.terminals)
         if self.state.truncated_tail_bytes:
@@ -234,19 +248,50 @@ class RequestJournal:
     # -- appends ---------------------------------------------------------
 
     def _append(self, rec: dict) -> None:
-        self.state.apply(rec)
+        if self.unavailable:
+            raise JournalUnavailableError(
+                f"request journal {self.path} is fail-closed after a write "
+                f"failure; restart to replay the durable prefix",
+                path=self.path)
         payload = json.dumps(rec, separators=(",", ":")).encode("utf-8")
         frame = _MAGIC + struct.pack(
             "!II", len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
-        self._f.write(frame)
-        self._f.flush()
-        if self.fsync:
-            os.fsync(self._f.fileno())
+        try:
+            if self._inj is not None:
+                self._inj.journal_append(self.path)
+            self._f.write(frame)
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+        except OSError as e:
+            # ENOSPC / injected permanent I/O error: fail closed. The
+            # mirror was NOT applied, so mirror == durable file and a
+            # restart over this path replays exactly what was promised.
+            self.unavailable = True
+            self.close()
+            raise JournalUnavailableError(
+                f"request journal {self.path}: append failed ({e}); "
+                f"journal is fail-closed until restart",
+                path=self.path) from e
+        # apply only after the frame is durable — the one ordering under
+        # which a failed append leaves no phantom state in the mirror
+        self.state.apply(rec)
         if self._tm is not None:
             self._tm.counter("router/journal/appends").inc()
         self._records_since_compact += 1
         if self._records_since_compact > self.rotate_max_records:
-            self.compact()
+            try:
+                self.compact()
+            except OSError as e:
+                # the record above IS durable; only the rewrite failed —
+                # but a full disk will fail the next append too, so the
+                # same fail-closed verdict applies
+                self.unavailable = True
+                self.close()
+                raise JournalUnavailableError(
+                    f"request journal {self.path}: rotation failed ({e}); "
+                    f"journal is fail-closed until restart",
+                    path=self.path) from e
             if self._tm is not None:
                 self._tm.counter("router/journal/rotations").inc()
 

@@ -79,7 +79,8 @@ from typing import Optional
 
 import numpy as np
 
-from ..resilience import (ControlPlaneCrash, FaultInjector, RequestRejected,
+from ..resilience import (ControlPlaneCrash, FaultInjector,
+                          JournalUnavailableError, RequestRejected,
                           RpcError, RpcTimeout)
 from ..resilience.retry import backoff_delay
 from ..runtime.config import (FaultInjectionConfig, IncidentConfig,
@@ -226,6 +227,7 @@ class Router:
         # fsyncs on the submit/terminal hot path.
         jc = rc.journal
         self._journal = None
+        self._journal_failure_noted = False  # one incident per fail-closed
         self._idem: dict[str, int] = {}  # idempotency key -> uid
         if jc.enabled:
             from .journal import RequestJournal
@@ -233,7 +235,8 @@ class Router:
             self._journal = RequestJournal(
                 jc.path, fsync=jc.fsync,
                 rotate_max_records=jc.rotate_max_records,
-                keep_terminals=jc.keep_terminals, telemetry=self.telemetry)
+                keep_terminals=jc.keep_terminals, telemetry=self.telemetry,
+                injector=self._inj)
             st = self._journal.state
             if self._journal.recovered and st.epoch_wall is not None:
                 # continue the fleet clock across the restart: in-flight
@@ -439,6 +442,17 @@ class Router:
         priority does the arrival itself bounce — typed ``overloaded`` so
         clients know to back off rather than hammer a saturated fleet."""
         tm = self.telemetry
+        if self._journal is not None and self._journal.unavailable:
+            # fail-closed: a journal that cannot persist accepts means the
+            # fleet must stop PROMISING — rejecting here is recoverable
+            # (the client retries after the restart); accepting a request
+            # the journal never recorded is not (docs/resilience.md)
+            tm.counter("router/journal/unavailable_rejects").inc()
+            self._count_reject(request.tenant)
+            raise RequestRejected(
+                request.uid, "journal_unavailable",
+                "request journal is fail-closed after a write failure; "
+                "accepts resume after a control-plane restart")
         healthy = self._dispatch_targets()
         if not healthy:
             tm.counter("router/shed").inc()
@@ -542,7 +556,29 @@ class Router:
             # COMPOSITE idem key — replay rebuilds the tenant-scoped map
             # without a format change; bare v1 keys land in the anonymous
             # pool (tenant_idem_key docstring).
-            self._journal.record_submit(request, key=scoped_key)
+            try:
+                self._journal.record_submit(request, key=scoped_key)
+            except JournalUnavailableError as e:
+                # UN-accept: the client is about to be told "rejected", so
+                # the fleet must not quietly keep working the request. The
+                # engine withdraw is best-effort (a prefill may already
+                # hold the slot; its orphaned completion is ignored by
+                # _record, the documented lost-reply semantics).
+                self._owner.pop(uid, None)
+                self._seen.pop(uid, None)
+                self._requests.pop(uid, None)
+                if scoped_key:
+                    self._idem.pop(scoped_key, None)
+                try:
+                    target.engine.withdraw(uid)
+                except (RpcError, OSError):
+                    pass
+                self._note_journal_failure(e)
+                self._count_reject(request.tenant)
+                raise RequestRejected(
+                    request.uid, "journal_unavailable",
+                    "request journal append failed (fail-closed); the "
+                    "accept was withdrawn") from e
         target.dispatched += 1
         tm.counter("router/dispatched").inc()
         if self.tracer is not None:
@@ -589,14 +625,24 @@ class Router:
         rid = self._owner.get(uid)
         if rid is None:
             return False
+        if self._terminal_not_durable(uid):
+            # fail closed: a cancel whose record cannot become durable
+            # would resurrect after restart and run anyway — refuse it
+            # (the client retries once the control plane restarts)
+            self.telemetry.counter("router/journal/parked_terminals").inc()
+            return False
         r = self._replicas[rid]
         if not r.engine.cancel(uid):
             return False
         if self._journal is not None:
             # the cancel record covers the crash window before the
             # terminal lands: a replay without the result still knows the
-            # user cancelled — the uid is never re-dispatched
-            self._journal.record_cancel(uid)
+            # user cancelled — the uid is never re-dispatched. Best-effort
+            # under a fail-closed journal, like every terminal append.
+            try:
+                self._journal.record_cancel(uid)
+            except JournalUnavailableError as e:
+                self._note_journal_failure(e)
         self._record(r, uid)
         self._pending_terminal.append(uid)
         return True
@@ -848,6 +894,13 @@ class Router:
                          f"fall through to failover", ranks=[0])
                 continue
             for uid, res in results.items():
+                if getattr(res, "status", "") == "cancelled":
+                    # a journaled-LIVE uid with a worker-side cancelled
+                    # result is an abandon orphan (the hung-verdict host
+                    # cancel), never a user cancel — a durable cancel
+                    # replays as a terminal and leaves the live set. The
+                    # real copy is in flight elsewhere or re-dispatches.
+                    continue
                 harvested.setdefault(uid, res)
             for uid in live:
                 if uid in st.requests:
@@ -861,7 +914,7 @@ class Router:
                 # result, make it durable NOW
                 res = harvested[uid]
                 self._results[uid] = res
-                self._journal.record_terminal(uid, res)
+                self._journal_terminal(uid, res)
                 self._pending_terminal.append(uid)
                 tm.counter("router/recovery/recovered_results").inc()
             elif uid in held:
@@ -896,23 +949,66 @@ class Router:
 
     # -- health / failover ----------------------------------------------
 
+    def _note_journal_failure(self, e: JournalUnavailableError) -> None:
+        """Account one failed journal append: counter + a ONE-TIME incident
+        trigger (the journal stays fail-closed until restart, so every
+        later append would re-fire the same root cause)."""
+        tm = self.telemetry
+        tm.counter("router/journal/append_failures").inc()
+        if not self._journal_failure_noted:
+            self._journal_failure_noted = True
+            self._incident("journal_unavailable", error=str(e),
+                           path=getattr(e, "path", ""))
+            log_dist(f"router: request journal fail-closed ({e}) — "
+                     f"rejecting new accepts until restart", ranks=[0])
+
+    def _journal_terminal(self, uid: int, res=None,
+                          status: str | None = None) -> None:
+        """Best-effort terminal append: a fail-closed journal must never
+        crash the serve loop mid-step — the restart re-derives lost
+        terminals from the workers (docs/resilience.md)."""
+        if self._journal is None:
+            return
+        try:
+            self._journal.record_terminal(uid, res, status=status)
+        except JournalUnavailableError as e:
+            self._note_journal_failure(e)
+
+    def _terminal_not_durable(self, uid: int) -> bool:
+        """True when a terminal for ``uid`` delivered NOW is guaranteed to
+        duplicate after a restart: the journal is fail-closed (the terminal
+        append cannot become durable) while the uid's SUBMIT is durable, so
+        the next incarnation will resurrect the request and deliver its own
+        terminal. Fail closed on the promise too: park the request and let
+        the restarted control plane resolve it exactly once. Uids the
+        journal never accepted cannot resurrect — they deliver normally
+        even while the journal is down."""
+        j = self._journal
+        return (j is not None and j.unavailable
+                and uid in j.state.requests)
+
     def _record(self, r: _Replica, uid: int) -> None:
         res = r.engine.result(uid)
         if res is None or self._owner.get(uid) != r.rid:
+            return
+        if self._terminal_not_durable(uid):
+            # the worker keeps the unacked result in its replay-safe
+            # buffer; recovery harvests it and makes it durable then
+            self.telemetry.counter("router/journal/parked_terminals").inc()
             return
         self._results[uid] = res
         r.completed += 1
         del self._owner[uid]
         self._seen.pop(uid, None)
         self._requests.pop(uid, None)
-        if self._journal is not None:
-            self._journal.record_terminal(uid, res)
+        self._journal_terminal(uid, res)
 
     def _collect(self, r: _Replica, uids, terminal: list) -> None:
         for uid in uids:
             if self._owner.get(uid) == r.rid and uid not in self._results:
                 self._record(r, uid)
-                terminal.append(uid)
+                if uid in self._results:  # parked terminals don't report
+                    terminal.append(uid)
 
     def _synth_result(self, req: Request, status: str) -> RequestResult:
         now = time.perf_counter() - self._epoch
@@ -924,10 +1020,9 @@ class Router:
         self._requests.pop(req.uid, None)
         if req.tenant and status.startswith("shed"):
             self.telemetry.counter(f"tenant/{req.tenant}/sheds").inc()
-        if self._journal is not None:
-            # skips uids the journal never accepted (a shed submit's
-            # synthesized result) — record_terminal filters those
-            self._journal.record_terminal(req.uid, res)
+        # skips uids the journal never accepted (a shed submit's
+        # synthesized result) — record_terminal filters those
+        self._journal_terminal(req.uid, res)
         self.telemetry.emit({
             "type": "request", "uid": req.uid, "slot": -1,
             "prompt_len": res.prompt_len, "n_tokens": 0, "status": status,
@@ -947,6 +1042,15 @@ class Router:
         if n >= 1 or not targets:
             self._owner.pop(req.uid, None)
             self._seen.pop(req.uid, None)
+            if self._terminal_not_durable(req.uid):
+                # a failed_replica verdict we cannot journal would be
+                # re-delivered by the restarted control plane (which may
+                # even harvest a real result instead) — park it live
+                tm.counter("router/journal/parked_terminals").inc()
+                log_dist(
+                    f"router: request {req.uid} failover spent under a "
+                    f"fail-closed journal — parked for restart", ranks=[0])
+                return
             self._synth_result(req, "failed_replica")
             terminal.append(req.uid)
             tm.counter("router/failed_requests").inc()
@@ -969,6 +1073,9 @@ class Router:
             # exactly-once budget is spent on the failed replay
             self._owner.pop(req.uid, None)
             self._seen.pop(req.uid, None)
+            if self._terminal_not_durable(req.uid):
+                tm.counter("router/journal/parked_terminals").inc()
+                return
             self._synth_result(req, "failed_replica")
             terminal.append(req.uid)
             tm.counter("router/failed_requests").inc()

@@ -118,6 +118,10 @@ _REASON_STATUS = {
     "forbidden": 403,
     "no_healthy_replicas": 503,
     "shutting_down": 503,
+    # the request journal failed closed (ENOSPC / write failure): the
+    # fleet refuses new promises until the control plane restarts over
+    # the durable prefix — a server-side outage, not client pressure
+    "journal_unavailable": 503,
 }
 
 

@@ -26,6 +26,7 @@ from .errors import (
     CheckpointNotFoundError,
     ControlPlaneCrash,
     JournalCorruptError,
+    JournalUnavailableError,
     PreemptionSignal,
     RequestRejected,
     ResilienceError,
@@ -58,6 +59,7 @@ __all__ = [
     "FaultInjector",
     "HeartbeatJudge",
     "JournalCorruptError",
+    "JournalUnavailableError",
     "PreemptionGuard",
     "PreemptionSignal",
     "RequestRejected",

@@ -91,6 +91,22 @@ class JournalCorruptError(ResilienceError):
         self.offset = offset
 
 
+class JournalUnavailableError(ResilienceError):
+    """The request journal failed to make an append durable (ENOSPC, a
+    failed fsync, or the injected journal-append ``io_error`` key) and has
+    gone FAIL-CLOSED: once an append cannot be persisted, nothing later in
+    the file can be trusted to survive a crash, so the journal refuses all
+    further appends until the process restarts over the durable prefix.
+    The accept path converts this into a typed ``journal_unavailable``
+    rejection (503 at the gateway) — losing an accept is recoverable by the
+    client retrying; silently accepting a request the journal never
+    recorded is the unrecoverable outcome (docs/resilience.md)."""
+
+    def __init__(self, message: str, path: str = ""):
+        super().__init__(message)
+        self.path = path
+
+
 class ControlPlaneCrash(ResilienceError):
     """Injected control-plane failure (the ``router_crash`` fault site): the
     Router raises this at the armed step, modelling the gateway+router

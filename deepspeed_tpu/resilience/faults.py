@@ -118,6 +118,10 @@ class FaultInjector:
         self.nan_grad_steps = set(_get(cfg, "nan_grad_steps", []) or [])
         self.io_error_writes = set(_get(cfg, "io_error_writes", []) or [])
         self.io_flaky_writes = set(_get(cfg, "io_flaky_writes", []) or [])
+        # journal-append clock (io_error family): 1-based indices of
+        # RequestJournal appends that fail permanently — the ENOSPC model
+        self.io_error_journal_appends = set(
+            _get(cfg, "io_error_journal_appends", []) or [])
         self.garbage_logits_uids = set(_get(cfg, "garbage_logits_uids", []) or [])
         self.garbage_logits_phase = str(_get(cfg, "garbage_logits_phase", "decode"))
         self.garbage_logits_decode_step = int(_get(cfg, "garbage_logits_decode_step", 0))
@@ -147,6 +151,7 @@ class FaultInjector:
         self.router_crash_at = set(
             _get(cfg, "router_crash_at", []) or [])
         self._writes = 0  # guarded-write clock (io_error site)
+        self._journal_appends = 0  # journal-append clock (io_error family)
         self._fired: set = set()  # list-mode keys fire exactly once
         self._lock = threading.Lock()
         self.injected: Counter = Counter()
@@ -212,6 +217,27 @@ class FaultInjector:
             raise TransientIOError(
                 f"fault injection: io_flaky (transient) on guarded write "
                 f"#{n} ({path})")
+
+    def journal_append(self, path: str) -> None:
+        """Journal-append hook (``io_error`` family): advances a dedicated
+        per-injector append clock and raises ``PermanentIOError`` when this
+        append index is armed via ``io_error_journal_appends`` (1-based).
+        A separate clock from the checkpoint write clock on purpose — a
+        schedule arming "the 3rd journal append" must not depend on how
+        many checkpoint writes happened first. The fired-set key is the
+        tuple ``("journal", n)`` so it can never collide with the plain
+        integer keys the guarded-write sites use."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._journal_appends += 1
+            n = self._journal_appends
+        if self._fire("io_error", n in self.io_error_journal_appends,
+                      ("journal", n)):
+            from .errors import PermanentIOError
+
+            raise PermanentIOError(
+                f"fault injection: io_error on journal append #{n} ({path})")
 
     def garbage_logits(self, uid: int, phase: str, decode_step: int = 0) -> bool:
         """True if request ``uid`` should produce NaN logits now. ``phase``
@@ -309,6 +335,7 @@ class FaultInjector:
             "injected": dict(self.injected),
             "opportunities": dict(self.opportunities),
             "guarded_writes": self._writes,
+            "journal_appends": self._journal_appends,
         }
 
 

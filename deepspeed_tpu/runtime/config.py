@@ -489,6 +489,11 @@ class FaultInjectionConfig:
       *transient* ``TransientIOError`` — the write clock advances across
       retries, so a retried save succeeds (the ``resilience.retry`` proof
       site).
+    - ``io_error_journal_appends``: 1-based indices of request-journal
+      appends that fail permanently (the ENOSPC/full-disk model, its own
+      clock separate from the checkpoint write clock) — the journal goes
+      fail-closed and the accept path rejects with ``journal_unavailable``
+      (``inference/journal.py`` consumes this; docs/resilience.md).
     - ``garbage_logits_uids`` (+ ``garbage_logits_phase`` ``prefill|decode``,
       ``garbage_logits_decode_step`` 0-based): serving requests whose slot KV
       is poisoned so the compiled program genuinely computes NaN logits.
@@ -527,6 +532,7 @@ class FaultInjectionConfig:
     nan_grad_steps: list = field(default_factory=list)
     io_error_writes: list = field(default_factory=list)
     io_flaky_writes: list = field(default_factory=list)
+    io_error_journal_appends: list = field(default_factory=list)
     garbage_logits_uids: list = field(default_factory=list)
     garbage_logits_phase: str = "decode"
     garbage_logits_decode_step: int = 0
@@ -585,6 +591,11 @@ class FaultInjectionConfig:
                 raise DeepSpeedConfigError(
                     f"fault_injection.router_crash_at entries must be "
                     f"1-based router steps (positive ints), got {s!r}")
+        for s in self.io_error_journal_appends:
+            if not isinstance(s, int) or s < 1:
+                raise DeepSpeedConfigError(
+                    f"fault_injection.io_error_journal_appends entries must "
+                    f"be 1-based append indices (positive ints), got {s!r}")
 
 
 @dataclass
@@ -661,6 +672,42 @@ class RetryConfig:
 
 
 @dataclass
+class ChaosConfig:
+    """``resilience.chaos`` block (consumed by ``resilience/chaos.py`` and
+    the ``bench.py --chaos-search`` drill; docs/resilience.md "Chaos
+    conductor").
+
+    - ``n_schedules``: schedules per search run (each a pure function of
+      ``seed`` + schedule index).
+    - ``seed``: search seed — same seed, same schedules, same artifacts.
+    - ``max_faults``: entries per generated schedule (1..max_faults drawn).
+    - ``artifact_dir``: where minimal ``chaos-repro-NNN.json`` reproducers
+      land (rename-durable writes).
+    - ``shrink``: delta-debug violating schedules to a minimal reproducer
+      before writing the artifact (off = write the full schedule).
+    """
+
+    n_schedules: int = 64
+    seed: int = 0
+    max_faults: int = 4
+    artifact_dir: str = "chaos-repros"
+    shrink: bool = True
+
+    def __post_init__(self):
+        if self.n_schedules < 1:
+            raise DeepSpeedConfigError(
+                f"resilience.chaos.n_schedules must be >= 1, got "
+                f"{self.n_schedules}")
+        if self.max_faults < 1:
+            raise DeepSpeedConfigError(
+                f"resilience.chaos.max_faults must be >= 1, got "
+                f"{self.max_faults}")
+        if not self.artifact_dir:
+            raise DeepSpeedConfigError(
+                "resilience.chaos.artifact_dir must be a non-empty path")
+
+
+@dataclass
 class ResilienceConfig:
     """Training resilience block (``resilience``; consumed by
     ``runtime/engine.py`` + ``resilience/guardrails.py``; docs/resilience.md).
@@ -683,6 +730,8 @@ class ResilienceConfig:
     - ``retry``: bounded-backoff policy wrapped around checkpoint saves
       (transient storage errors survive; permanent ones still surface).
     - ``fault_injection``: deterministic fault source for tests/CI smoke.
+    - ``chaos``: seeded fault-space search over generated schedules (its
+      own dataclass above).
     """
 
     enabled: bool = False
@@ -691,6 +740,7 @@ class ResilienceConfig:
     preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
     retry: RetryConfig = field(default_factory=RetryConfig)
     fault_injection: FaultInjectionConfig = field(default_factory=FaultInjectionConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     def __post_init__(self):
         if isinstance(self.preemption, dict):
@@ -699,6 +749,8 @@ class ResilienceConfig:
             self.retry = _build(RetryConfig, self.retry)
         if isinstance(self.fault_injection, dict):
             self.fault_injection = _build(FaultInjectionConfig, self.fault_injection)
+        if isinstance(self.chaos, dict):
+            self.chaos = _build(ChaosConfig, self.chaos)
         if self.max_consecutive_bad_steps < 1:
             raise DeepSpeedConfigError(
                 "resilience.max_consecutive_bad_steps must be >= 1, got "

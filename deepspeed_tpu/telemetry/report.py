@@ -23,6 +23,9 @@ Pretty-prints, for CI logs and bench triage:
     resident entries) when the run's snapshot carries one,
   * the resilience table (``resilience/*`` recovery/degradation counters,
     fault-injector fired/opportunity ratios, non-ok request statuses),
+  * the chaos fault-site coverage table (``chaos/site/<name>/fired`` vs
+    ``survived`` per site, fired > survived flagged TRIPPED) when a
+    chaos search ran against the registry,
   * the serving-router table (per-replica health state and
     dispatched/failed-over/drained/completed counts plus the ``router/*``
     counters) when the snapshot came from a ``Router``,
@@ -598,6 +601,28 @@ def summarize(events: list[dict], top: int = 10) -> str:
         if statuses:
             lines.append("  degraded requests: " + " ".join(
                 f"{k}={v}" for k, v in sorted(statuses.items())))
+        lines.append("")
+
+    # -- chaos fault-site coverage (docs/resilience.md "Chaos conductor"):
+    # chaos/site/<name>/fired counts schedules where the site's fault
+    # actually fired; /survived counts those that then passed every
+    # invariant oracle. fired > survived means a schedule tripped — look
+    # for a chaos-repro artifact.
+    chaos = {}
+    if snap is not None:
+        for name, v in snap.get("metrics", {}).get("counters", {}).items():
+            if name.startswith("chaos/site/"):
+                parts = name.split("/")
+                if len(parts) == 4:
+                    chaos.setdefault(parts[2], {})[parts[3]] = v
+    if chaos:
+        lines.append(f"chaos fault-site coverage ({len(chaos)} sites):")
+        lines.append(f"  {'site':<20} {'fired':>7} {'survived':>9}  verdict")
+        for site in sorted(chaos):
+            fired = chaos[site].get("fired", 0)
+            survived = chaos[site].get("survived", 0)
+            verdict = "green" if survived >= fired else "TRIPPED"
+            lines.append(f"  {site:<20} {fired:>7g} {survived:>9g}  {verdict}")
         lines.append("")
 
     if snap is not None:

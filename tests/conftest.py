@@ -170,6 +170,21 @@ def pytest_sessionfinish(session, exitstatus):
     except Exception as e:  # noqa: BLE001 — advisory only, never fails a run
         print(f"[conftest] bench-trajectory verdict skipped: {e}")
 
+    # One-line fault-site coverage verdict beside the others: every
+    # FaultInjector site must keep at least one exercising tier-1 test or
+    # bench drill (docs/resilience.md "Chaos conductor"). The failing gate
+    # is tests/test_chaos.py; this line keeps the registry/coverage state
+    # visible on runs that deselect it. Warn-only by construction.
+    cov = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                       "bin", "dstpu_chaos_coverage")
+    try:
+        proc = subprocess.run([sys.executable, cov], capture_output=True,
+                              text=True, timeout=30)
+        verdict = (proc.stdout.strip().splitlines() or ["no output"])[-1]
+        print(f"-- {verdict} (bin/dstpu_chaos_coverage, warn-only) --")
+    except Exception as e:  # noqa: BLE001 — advisory only, never fails a run
+        print(f"[conftest] chaos-coverage verdict skipped: {e}")
+
 
 @pytest.fixture(scope="session")
 def tiny_serving_engine():

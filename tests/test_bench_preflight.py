@@ -224,6 +224,35 @@ def test_disagg_argv_contract_exits_2_with_usage(argv):
     assert "Traceback" not in proc.stderr
 
 
+@pytest.mark.parametrize("argv", [
+    ("--chaos-search", "0"),                          # n below floor
+    ("--chaos-search", "xyz"),                        # non-numeric operand
+    ("--chaos-search", "8", "--chaos-search-seed"),   # dangling seed flag
+    ("--chaos-search", "--chaos-search-seed", "xyz"),  # non-numeric seed
+])
+def test_chaos_search_argv_contract_exits_2_with_usage(argv):
+    """``--chaos-search`` follows the sibling-drill contract: malformed
+    operands exit 2 with a usage line on stderr — never a traceback,
+    never a started search."""
+    proc = _run_bench_argv(*argv)
+    assert proc.returncode == 2, (argv, proc.stderr)
+    assert "usage: bench.py --chaos-search" in proc.stderr
+    assert "Traceback" not in proc.stderr
+
+
+@pytest.mark.parametrize("argv", [
+    ("--chaos-replay",),                  # missing FILE operand
+    ("--chaos-replay", "--chaos-search"),  # flag where FILE belongs
+])
+def test_chaos_replay_argv_contract_exits_2_with_usage(argv):
+    """``--chaos-replay`` requires its FILE operand: missing or
+    flag-shaped operands exit 2 with a usage line on stderr."""
+    proc = _run_bench_argv(*argv)
+    assert proc.returncode == 2, (argv, proc.stderr)
+    assert "usage: bench.py --chaos-replay" in proc.stderr
+    assert "Traceback" not in proc.stderr
+
+
 def test_drill_rows_carry_the_stamp_contract(bench):
     """Every CPU-pinned drill row (incl. the --gateway-chaos row) carries
     the full ``_stamp_row`` provenance block — platform cpu, comparable

@@ -312,7 +312,14 @@ def test_sse_stream_framing_and_done_event(request):
     assert len(done) == 1
     assert done[0]["data"]["status"] == "ok"
     assert done[0]["data"]["tokens"] == [7, 8, 9]
-    counters = router.telemetry.registry.snapshot()["counters"]
+    # the handler thread increments streams_done AFTER writing the done
+    # frame, so the client can observe the frame first — poll briefly
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        counters = router.telemetry.registry.snapshot()["counters"]
+        if "gateway/streams_done" in counters:
+            break
+        time.sleep(0.01)
     assert counters["gateway/streams_done"] == 1
 
 
